@@ -1,0 +1,27 @@
+"""fed_tgan_tpu — a TPU-native federated tabular-GAN framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of Fed-TGAN
+(arXiv:2108.07927; reference implementation `zhao-zilong/Fed-TGAN`):
+federated training of a conditional tabular GAN (CTGAN-style, WGAN-GP,
+mode-specific normalization) with column-similarity-weighted FedAvg.
+
+Where the reference runs one process per participant glued together with
+PyTorch RPC over Gloo/TensorPipe (reference Server/dtds/distributed.py:849-857),
+this framework runs ONE SPMD program over a `jax.sharding.Mesh` with a
+`clients` axis: each device holds one participant's data shard, local
+training is a jitted per-device region, and the per-epoch weighted model
+aggregation is a single `lax.psum` collective over ICI.
+
+Layout:
+- ``data``       — schema/metadata, CSV ingestion, dates, decode, sharding
+- ``features``   — Bayesian-GMM mode-specific normalization (fit/refit/transform)
+- ``ops``        — segment ops (gumbel-softmax, segment CE) on static layouts
+- ``models``     — CTGAN generator/discriminator as parameter pytrees
+- ``train``      — standalone + federated trainers, device-side samplers
+- ``federation`` — host-side init: category harmonization, GMM refit, weights
+- ``parallel``   — mesh construction, in-graph weighted FedAvg collectives
+- ``eval``       — statistical-similarity and ML-utility evaluation
+- ``runtime``    — native (C++) host transport for multi-host control plane
+"""
+
+__version__ = "0.1.0"

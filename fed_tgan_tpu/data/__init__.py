@@ -1,0 +1,15 @@
+from fed_tgan_tpu.data.constants import BIMODAL, CATEGORICAL, CONTINUOUS, ORDINAL
+from fed_tgan_tpu.data.encoders import CategoryEncoder
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.schema import ColumnMeta, TableMeta
+
+__all__ = [
+    "BIMODAL",
+    "CATEGORICAL",
+    "CONTINUOUS",
+    "ORDINAL",
+    "CategoryEncoder",
+    "ColumnMeta",
+    "TableMeta",
+    "TablePreprocessor",
+]

@@ -1,0 +1,30 @@
+"""Column-kind constants.
+
+Mirrors the reference's vocabulary (reference Server/dtds/data/constants.py:1-3
+and the client-side extra BIMODAL at
+Client/distributed_GAN_MDGAN_Client0/dtds/data/constants.py:4).
+
+Note the reference's meta JSON spells the continuous kind "continous" (sic,
+reference Server/dtds/data/utils/file_generator.py:212); we accept both
+spellings on input and emit the misspelled one for byte-compatibility with
+reference tooling.
+"""
+
+CATEGORICAL = "categorical"
+CONTINUOUS = "continuous"
+ORDINAL = "ordinal"
+BIMODAL = "bimodal"
+
+# The misspelled kind tag used inside reference meta JSON files.
+CONTINUOUS_JSON = "continous"
+
+MISSING_TOKEN = "empty"
+
+# Sentinel for missing values in continuous columns.  The reference's decode
+# path documents this convention (Server/dtds/features/transformers.py:671:
+# "for -999999 taking np.exp(-999999)-1 gives -1", which maps back to 'empty').
+MISSING_CONTINUOUS = -999999.0
+
+
+def is_continuous_kind(kind: str) -> bool:
+    return kind in (CONTINUOUS, CONTINUOUS_JSON)

@@ -1,0 +1,145 @@
+"""Date column splitting and rejoining.
+
+Behavioral equivalent of the reference's ``Date`` utility
+(reference Server/dtds/data/utils/date.py:14-200): a date column declared as
+e.g. ``{"date": "yymmdd|YYYY-MM-DD"}`` is parsed and split into categorical
+part-columns (``date-year``, ``date-month``, ...); on inverse, parts are
+rejoined and impossible day-of-month values are clamped.
+
+Deviations from the reference (documented, intentional):
+- leap years use the correct Gregorian rule (the reference requires
+  ``y%4==0 and y%100==0 and y%400==0`` at date.py:166-170, which mislabels
+  ordinary leap years such as 2024);
+- vectorized pandas ops instead of per-row Python loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from fed_tgan_tpu.data.constants import MISSING_TOKEN
+
+# part-name suffix per format token (reference date.py:78)
+_PART_SUFFIX = {
+    "YYYY": "-year",
+    "MM": "-month",
+    "DD": "-day",
+    "hh": "-hour",
+    "mm": "-minute",
+    "ss": "-second",
+}
+_PART_STRFTIME = {
+    "YYYY": "%y",  # reference emits 2-digit years for YYYY (date.py:84-86)
+    "MM": "%m",
+    "DD": "%d",
+    "hh": "%H",
+    "mm": "%M",
+    "ss": "%S",
+}
+
+_DAYS_IN_MONTH = {1: 31, 2: 28, 3: 31, 4: 30, 5: 31, 6: 30, 7: 31, 8: 31, 9: 30, 10: 31, 11: 30, 12: 31}
+
+
+def _parse_format(fmt: str) -> tuple[str | None, str]:
+    """Split ``"origin|PARTS"`` into (origin_format, part_format)."""
+    pieces = fmt.split("|")
+    if len(pieces) == 2:
+        return pieces[0], pieces[1]
+    return None, pieces[0]
+
+
+def part_columns(column: str, fmt: str) -> list[str]:
+    _, d_format = _parse_format(fmt)
+    return [column + _PART_SUFFIX[tok] for tok in d_format.split("-")]
+
+
+def split_date_columns(
+    df: pd.DataFrame, date_formats: dict[str, str], categorical_list: list[str]
+) -> pd.DataFrame:
+    """Replace each declared date column by categorical part-columns.
+
+    ``categorical_list`` is edited in place the same way the reference does
+    (date column removed, part columns appended; date.py:28,113).
+    """
+    df = df.copy()
+    for column, fmt in date_formats.items():
+        if column in categorical_list:
+            categorical_list.remove(column)
+        o_format, d_format = _parse_format(fmt)
+
+        raw = df[column]
+        missing = raw.astype(str).eq(MISSING_TOKEN) | raw.isna()
+        if o_format == "yymmdd":
+            # numeric yymmdd stamps; floats appear when the column had NaNs.
+            # Zero-pad and parse with an explicit format — years 2000-2009
+            # lose their leading zero through the int cast.
+            parseable = raw[~missing].astype(float).astype(int).astype(str).str.zfill(6)
+            parsed = pd.to_datetime(parseable, format="%y%m%d")
+        else:
+            parsed = pd.to_datetime(raw[~missing].astype(str))
+
+        for tok in d_format.split("-"):
+            part = column + _PART_SUFFIX[tok]
+            out = pd.Series(MISSING_TOKEN, index=df.index, dtype=object)
+            out.loc[~missing] = parsed.dt.strftime(_PART_STRFTIME[tok])
+            df[part] = out
+            categorical_list.append(part)
+
+        df = df.drop(columns=[column])
+    return df
+
+
+def _is_leap(year: np.ndarray) -> np.ndarray:
+    return ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
+
+
+def join_date_columns(df: pd.DataFrame, date_formats: dict[str, str]) -> pd.DataFrame:
+    """Rejoin part-columns into the original date column, clamping bad days.
+
+    Mirrors reference date.py:119-200: a row is "empty" if any part is empty;
+    day-of-month beyond the month's maximum is clamped (Feb respecting leap
+    years, other overlong days to 30 like the reference).
+    """
+    df = df.copy()
+    for column, fmt in date_formats.items():
+        o_format, d_format = _parse_format(fmt)
+        parts = [column + _PART_SUFFIX[tok] for tok in d_format.split("-")]
+        part_vals = df[parts].astype(str)
+
+        missing = part_vals.apply(lambda s: s.str.contains(MISSING_TOKEN)).any(axis=1)
+        # object dtype: the column ends up holding Timestamps or ints plus
+        # the missing token (pandas 3 string dtype would reject those)
+        joined = part_vals.apply(lambda row: "-".join(row), axis=1).astype(object)
+
+        if {"-year", "-month", "-day"} <= {s[len(column):] for s in parts}:
+            ok = ~missing
+            pieces = part_vals.loc[ok]
+            year = pieces[column + "-year"].astype(int).to_numpy()
+            month = pieces[column + "-month"].astype(int).to_numpy()
+            day = pieces[column + "-day"].astype(int).to_numpy()
+            max_day = np.array([_DAYS_IN_MONTH[m] for m in month])
+            max_day = np.where((month == 2) & _is_leap(2000 + year % 100), 29, max_day)
+            # reference clamps non-February overruns to 30 (date.py:175)
+            clamped = np.where(day > max_day, np.where(month == 2, max_day, 30), day)
+            fixed = [
+                "-".join([y, m, f"{d:02d}"])
+                for y, m, d in zip(
+                    pieces[column + "-year"], pieces[column + "-month"], clamped
+                )
+            ]
+            joined.loc[ok] = fixed
+
+        joined.loc[missing] = MISSING_TOKEN
+
+        ok = ~missing
+        stamped = pd.to_datetime(joined.loc[ok], format="%y-%m-%d")
+        if o_format == "yymmdd":
+            joined.loc[ok] = stamped.dt.strftime("%y%m%d").astype(int)
+        else:
+            # reference restores full datetimes (date.py:190), so the output
+            # CSV carries e.g. '2023-01-31', matching the raw column format
+            joined.loc[ok] = stamped
+        df[column] = joined
+        df = df.drop(columns=parts)
+    return df

@@ -1,0 +1,71 @@
+"""Decoding synthetic matrices back to raw-format dataframes/CSV.
+
+Behavioral equivalent of the reference ``Transform.inverse``
+(reference Server/dtds/data/utils/transform.py:12-69) with the optional
+integer casting of ``decode_train_data``
+(reference Server/dtds/features/transformers.py:629-699):
+
+- categorical codes -> original category values via the global encoders;
+- non-negative columns: ``exp(x) - 1`` (ceil when negative), ``-1`` -> 'empty';
+- date part-columns rejoined; 'empty' -> ' '.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import pandas as pd
+
+from fed_tgan_tpu.data.constants import MISSING_CONTINUOUS, MISSING_TOKEN
+from fed_tgan_tpu.data.dates import join_date_columns
+from fed_tgan_tpu.data.encoders import CategoryEncoder
+from fed_tgan_tpu.data.schema import TableMeta
+
+
+def decode_matrix(
+    data: np.ndarray,
+    meta: TableMeta,
+    encoders: Sequence[CategoryEncoder],
+    round_integers: bool = False,
+) -> pd.DataFrame:
+    """Decode a synthesized (or encoded-real) matrix to raw values.
+
+    ``round_integers=False`` reproduces the reference's federated sampling
+    path (Transform.inverse leaves integer continuous columns as floats);
+    ``True`` additionally casts integer columns like decode_train_data does.
+    """
+    df = pd.DataFrame(np.asarray(data), columns=meta.column_names)
+
+    cat_names = meta.categorical_columns
+    assert len(cat_names) == len(encoders), (len(cat_names), len(encoders))
+    for name, enc in zip(cat_names, encoders):
+        df[name] = enc.inverse_transform(df[name].to_numpy().astype(int))
+
+    cont_names = set(meta.continuous_columns)
+    for name in df.columns:
+        if name in meta.non_negative_columns:
+            x = np.exp(df[name].astype(float).to_numpy()) - 1.0
+            x = np.where(x < 0, np.ceil(x), x)
+            vals = pd.Series(x, index=df.index, dtype=object)
+            vals[x == -1] = MISSING_TOKEN
+            df[name] = vals
+        elif name in cont_names:
+            x = df[name].astype(float).to_numpy()
+            if (x == MISSING_CONTINUOUS).any():
+                vals = pd.Series(x, index=df.index, dtype=object)
+                vals[x == MISSING_CONTINUOUS] = MISSING_TOKEN
+                df[name] = vals
+
+    if meta.date_info:
+        df = join_date_columns(df, meta.date_info)
+
+    df = df.replace(MISSING_TOKEN, " ")
+
+    if round_integers:
+        for name in meta.integer_columns:
+            if name in df.columns:
+                df[name] = df[name].apply(
+                    lambda x: int(float(x)) if x != " " else " "
+                )
+    return df

@@ -1,0 +1,53 @@
+"""Categorical label encoding.
+
+Functional equivalent of the sklearn ``LabelEncoder`` objects the reference
+passes around over RPC (reference Server/dtds/distributed.py:622-624,
+Server/dtds/data/utils/file_generator.py:166): classes are the *sorted*
+unique values, codes are positions in that sorted order.  Implemented on
+numpy directly so encoders are cheap to serialize and need no sklearn at
+decode time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class CategoryEncoder:
+    """Maps category values <-> integer codes, sklearn-LabelEncoder-compatible."""
+
+    classes_: np.ndarray = field(default_factory=lambda: np.empty(0, dtype=object))
+
+    @classmethod
+    def fit(cls, values) -> "CategoryEncoder":
+        arr = np.asarray(list(values), dtype=object)
+        # np.unique on object arrays matches sklearn's sorted-class semantics.
+        return cls(classes_=np.unique(arr))
+
+    def transform(self, values) -> np.ndarray:
+        arr = np.asarray(list(values), dtype=object)
+        codes = np.searchsorted(self.classes_, arr)
+        codes = np.clip(codes, 0, len(self.classes_) - 1)
+        if not np.array_equal(self.classes_[codes], arr):
+            unknown = sorted({v for v in arr.tolist() if v not in set(self.classes_.tolist())})
+            raise ValueError(f"unknown categories: {unknown[:10]}")
+        return codes.astype(np.int64)
+
+    def inverse_transform(self, codes) -> np.ndarray:
+        codes = np.asarray(codes, dtype=np.int64)
+        if codes.size and (codes.min() < 0 or codes.max() >= len(self.classes_)):
+            raise ValueError("category code out of range")
+        return self.classes_[codes]
+
+    def __len__(self) -> int:
+        return len(self.classes_)
+
+    def to_dict(self) -> dict:
+        return {"classes": self.classes_.tolist()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CategoryEncoder":
+        return cls(classes_=np.asarray(d["classes"], dtype=object))

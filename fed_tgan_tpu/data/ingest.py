@@ -1,0 +1,166 @@
+"""CSV ingestion and per-client preprocessing.
+
+Behavioral equivalent of the reference ``FileGenerator``
+(reference Server/dtds/data/utils/file_generator.py:65-188) and the
+``prepare_data`` / ``encode_data_with_meta_labelencoder`` wrappers
+(reference Server/dtds/data/load.py:51-90), without the npz/json round-trip
+through disk: preprocessing produces the local meta dict and, once global
+encoders exist, a dense numpy matrix ready for the feature transformer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from fed_tgan_tpu.data.constants import (
+    CATEGORICAL,
+    CONTINUOUS,
+    MISSING_CONTINUOUS,
+    MISSING_TOKEN,
+)
+from fed_tgan_tpu.data.dates import split_date_columns
+from fed_tgan_tpu.data.encoders import CategoryEncoder
+from fed_tgan_tpu.data.schema import ColumnMeta, TableMeta
+
+
+def infer_integer_columns(df: pd.DataFrame) -> list[str]:
+    """Columns whose non-null values are all integral.
+
+    Mirrors reference file_generator.py:104-110 (int dtype, or float dtype
+    whose non-null values equal their int cast).
+    """
+    out = []
+    for name in df.columns:
+        col = df[name].dropna()
+        dtype = str(col.dtype)
+        if "int" in dtype:
+            out.append(name)
+        elif "float" in dtype and np.array_equal(col.to_numpy(), col.to_numpy().astype(int)):
+            out.append(name)
+    return out
+
+
+@dataclass
+class TablePreprocessor:
+    """Holds one participant's preprocessed dataframe.
+
+    Preprocessing pipeline (same order as reference file_generator.py:103-133):
+    1. integer-column inference on the raw frame;
+    2. blank cells -> NaN -> the ``'empty'`` token;
+    3. ``log(x+1)`` on non-negative continuous columns;
+    4. date columns split into categorical part-columns.
+    """
+
+    frame: pd.DataFrame
+    name: str = "table"
+    categorical_columns: list = field(default_factory=list)
+    non_negative_columns: list = field(default_factory=list)
+    date_formats: dict = field(default_factory=dict)
+    target_column: str = ""
+    problem_type: str = ""
+    selected_columns: Optional[Sequence[str]] = None
+
+    def __post_init__(self):
+        df = self.frame
+        if self.selected_columns is not None:
+            df = df[list(self.selected_columns)]
+        df = df.copy()
+
+        self.categorical_columns = list(self.categorical_columns)
+        self.integer_columns = infer_integer_columns(df)
+
+        df = df.replace(r" ", np.nan).fillna(MISSING_TOKEN)
+
+        exempt = set(self.categorical_columns) | set(self.date_formats.keys())
+        for col in df.columns:
+            if col in exempt:
+                continue
+            missing = df[col].astype(str).eq(MISSING_TOKEN)
+            if not missing.any() and col not in self.non_negative_columns:
+                continue
+            # errors="raise": only genuinely-missing cells may become the
+            # sentinel; stray tokens like '?' must fail loudly.
+            vals = pd.to_numeric(df[col].where(~missing), errors="raise")
+            if col in self.non_negative_columns:
+                vals = np.log(vals + 1.0)
+            vals = vals.fillna(MISSING_CONTINUOUS)
+            df[col] = vals.astype(float)
+
+        if self.date_formats:
+            self.categorical_columns.extend(self.date_formats.keys())
+            df = split_date_columns(df, self.date_formats, self.categorical_columns)
+
+        self.df = df
+
+    @classmethod
+    def from_csv(cls, path: str, **kwargs) -> "TablePreprocessor":
+        name = str(path).rsplit("/", 1)[-1].rsplit(".", 1)[0]
+        return cls(frame=pd.read_csv(path), name=kwargs.pop("name", name), **kwargs)
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.df)
+
+    def local_meta(self) -> dict:
+        """Per-client meta with categorical frequency dicts.
+
+        Equivalent of reference ``FileGenerator.generate_meta_data``
+        (file_generator.py:191-231); the frequency dicts are what the server
+        merges during category harmonization.
+        """
+        columns = []
+        for idx, col in enumerate(self.df.columns):
+            entry: dict = {"column_name": col, "column no": idx}
+            if col in self.categorical_columns:
+                counts = self.df[col].astype(str).value_counts()
+                entry["type"] = "categorical"
+                entry["size"] = len(counts)
+                entry["i2s"] = {str(k): int(v) for k, v in counts.items()}
+            else:
+                entry["type"] = "continous"  # reference spelling
+                vals = self.df[col].to_numpy(dtype=float)
+                present = vals[vals != MISSING_CONTINUOUS]
+                if present.size == 0:
+                    present = vals
+                entry["min"] = float(np.min(present))
+                entry["max"] = float(np.max(present))
+            columns.append(entry)
+        meta = {
+            "columns": columns,
+            "problem_type": self.problem_type,
+            "name": self.name,
+            "date_info": dict(self.date_formats),
+            "integer_info": list(self.integer_columns),
+            "non_negative_cols": list(self.non_negative_columns),
+        }
+        if self.target_column:
+            meta["target"] = self.target_column
+        return meta
+
+    def encode(
+        self, encoders: Sequence[CategoryEncoder]
+    ) -> tuple[np.ndarray, list[int], list[int]]:
+        """Label-encode categorical columns with the *global* encoders.
+
+        Equivalent of reference ``FileGenerator.generate_data`` +
+        ``load_datapath`` (file_generator.py:156-188, load.py:38-48) minus the
+        disk round-trip.  Returns (matrix, categorical_idx, ordinal_idx).
+        """
+        df = self.df.copy()
+        cursor = 0
+        cat_idx = []
+        for idx, col in enumerate(df.columns):
+            if col in self.categorical_columns:
+                df[col] = encoders[cursor].transform(df[col].astype(str))
+                cursor += 1
+                cat_idx.append(idx)
+        matrix = df.to_numpy(dtype=np.float64)
+        return matrix, cat_idx, []
+
+    def global_table_meta(self, harmonized_meta: dict) -> TableMeta:
+        """Wrap a server-harmonized meta dict into a ``TableMeta``."""
+        return TableMeta.from_json_dict(harmonized_meta)

@@ -1,0 +1,44 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; all mesh/collective tests run
+on XLA's host platform with 8 virtual devices, which exercises the same
+SPMD partitioning and collective lowering paths.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def toy_frame() -> pd.DataFrame:
+    """Small mixed-type table: 2 continuous, 2 categorical, 1 non-negative."""
+    rng = np.random.default_rng(7)
+    n = 600
+    return pd.DataFrame(
+        {
+            "amount": np.exp(rng.normal(2.0, 1.0, n)).round(2),
+            "score": np.concatenate(
+                [rng.normal(-4.0, 0.5, n // 2), rng.normal(3.0, 1.0, n - n // 2)]
+            ),
+            "color": rng.choice(["red", "green", "blue"], n, p=[0.6, 0.3, 0.1]),
+            "flag": rng.choice(["yes", "no"], n, p=[0.8, 0.2]),
+        }
+    )
+
+
+@pytest.fixture(scope="session")
+def toy_spec() -> dict:
+    return {
+        "categorical_columns": ["color", "flag"],
+        "non_negative_columns": ["amount"],
+        "target_column": "flag",
+        "problem_type": "binary_classification",
+    }

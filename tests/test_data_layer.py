@@ -1,0 +1,143 @@
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fed_tgan_tpu.data.constants import MISSING_TOKEN
+from fed_tgan_tpu.data.dates import join_date_columns, split_date_columns
+from fed_tgan_tpu.data.decode import decode_matrix
+from fed_tgan_tpu.data.encoders import CategoryEncoder
+from fed_tgan_tpu.data.ingest import TablePreprocessor, infer_integer_columns
+from fed_tgan_tpu.data.schema import TableMeta
+from fed_tgan_tpu.data.sharding import shard_dataframe, shard_indices
+
+
+def test_category_encoder_matches_sklearn_semantics():
+    enc = CategoryEncoder.fit(["b", "a", "c", "a"])
+    assert enc.classes_.tolist() == ["a", "b", "c"]
+    codes = enc.transform(["c", "a", "b"])
+    assert codes.tolist() == [2, 0, 1]
+    assert enc.inverse_transform(codes).tolist() == ["c", "a", "b"]
+    with pytest.raises(ValueError):
+        enc.transform(["zzz"])
+    rt = CategoryEncoder.from_dict(enc.to_dict())
+    assert rt.classes_.tolist() == enc.classes_.tolist()
+
+
+def test_integer_inference():
+    df = pd.DataFrame(
+        {
+            "a": [1, 2, 3],
+            "b": [1.0, 2.0, 3.0],
+            "c": [1.5, 2.0, 3.0],
+            "d": ["x", "y", "z"],
+        }
+    )
+    assert infer_integer_columns(df) == ["a", "b"]
+
+
+def test_preprocessor_missing_and_log(toy_frame, toy_spec):
+    df = toy_frame.copy()
+    df.loc[0, "color"] = " "
+    pre = TablePreprocessor(frame=df, **toy_spec)
+    # blank became the missing token
+    assert pre.df.loc[0, "color"] == MISSING_TOKEN
+    # non-negative column was log1p'd
+    assert np.allclose(
+        pre.df["amount"].to_numpy(),
+        np.log(df["amount"].to_numpy() + 1.0),
+    )
+
+
+def test_local_meta_frequency_dicts(toy_frame, toy_spec):
+    pre = TablePreprocessor(frame=toy_frame, **toy_spec)
+    meta = pre.local_meta()
+    cols = {c["column_name"]: c for c in meta["columns"]}
+    assert cols["color"]["type"] == "categorical"
+    assert sum(cols["color"]["i2s"].values()) == len(toy_frame)
+    assert cols["score"]["type"] == "continous"
+    assert cols["score"]["min"] == pytest.approx(toy_frame["score"].min())
+    assert meta["target"] == "flag"
+
+
+def test_meta_json_roundtrip(tmp_path, toy_frame, toy_spec):
+    pre = TablePreprocessor(frame=toy_frame, **toy_spec)
+    raw = pre.local_meta()
+    # harmonized flavor: i2s as ordered list
+    for c in raw["columns"]:
+        if c["type"] == "categorical":
+            c["i2s"] = list(c["i2s"].keys())
+    meta = TableMeta.from_json_dict(raw)
+    path = tmp_path / "meta.json"
+    meta.dump_json(str(path))
+    again = TableMeta.load_json(str(path))
+    assert again.column_names == meta.column_names
+    assert json.loads(path.read_text())["columns"][0]["type"] in ("continous", "categorical")
+
+
+def test_encode_decode_roundtrip(toy_frame, toy_spec):
+    pre = TablePreprocessor(frame=toy_frame, **toy_spec)
+    local = pre.local_meta()
+    encoders = []
+    meta_dict = {k: v for k, v in local.items()}
+    for c in meta_dict["columns"]:
+        if c["type"] == "categorical":
+            enc = CategoryEncoder.fit(list(c["i2s"].keys()))
+            c["i2s"] = enc.transform(list(c["i2s"].keys())).tolist()
+            encoders.append(enc)
+    matrix, cat_idx, _ = pre.encode(encoders)
+    assert matrix.shape == (len(toy_frame), 4)
+    assert cat_idx == [2, 3]
+
+    meta = TableMeta.from_json_dict(meta_dict)
+    decoded = decode_matrix(matrix, meta, encoders)
+    # categorical values round-trip exactly
+    assert (decoded["color"].to_numpy() == toy_frame["color"].to_numpy()).all()
+    # non-negative round-trips through log1p/expm1
+    assert np.allclose(
+        decoded["amount"].astype(float).to_numpy(),
+        toy_frame["amount"].to_numpy(),
+        rtol=1e-6,
+    )
+
+
+def test_date_split_and_join():
+    df = pd.DataFrame({"when": ["2023-01-31", "2024-02-29", MISSING_TOKEN], "v": [1, 2, 3]})
+    cats = ["when"]
+    out = split_date_columns(df, {"when": "YYYY-MM-DD"}, cats)
+    assert "when" not in out.columns
+    assert set(cats) == {"when-year", "when-month", "when-day"}
+    assert out.loc[0, "when-month"] == "01"
+    assert out.loc[2, "when-day"] == MISSING_TOKEN
+
+    joined = join_date_columns(out, {"when": "YYYY-MM-DD"})
+    assert joined.loc[0, "when"] == pd.Timestamp("2023-01-31")
+    assert joined.loc[1, "when"] == pd.Timestamp("2024-02-29")  # leap year
+    assert joined.loc[2, "when"] == MISSING_TOKEN
+
+
+def test_date_day_clamping():
+    df = pd.DataFrame(
+        {
+            "when-year": ["23", "23"],
+            "when-month": ["02", "04"],
+            "when-day": ["30", "31"],
+        }
+    )
+    joined = join_date_columns(df, {"when": "YYYY-MM-DD"})
+    assert joined.loc[0, "when"] == pd.Timestamp("2023-02-28")  # non-leap Feb clamps
+    assert joined.loc[1, "when"] == pd.Timestamp("2023-04-30")
+
+
+def test_sharding_strategies(toy_frame):
+    parts = shard_indices(100, 3, "iid", seed=1)
+    assert sum(len(p) for p in parts) == 100
+    assert len(np.unique(np.concatenate(parts))) == 100
+
+    labels = np.array([0] * 50 + [1] * 50)
+    skew = shard_indices(100, 2, "label_sorted", labels=labels)
+    assert (labels[skew[0]] == 0).all()
+
+    dfs = shard_dataframe(toy_frame, 4, "dirichlet", label_column="flag", alpha=0.1, seed=3)
+    assert sum(len(d) for d in dfs) == len(toy_frame)
