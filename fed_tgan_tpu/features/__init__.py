@@ -1,0 +1,4 @@
+from fed_tgan_tpu.features.bgm import ColumnGMM, fit_column_gmm
+from fed_tgan_tpu.features.transformer import ModeNormalizer
+
+__all__ = ["ColumnGMM", "ModeNormalizer", "fit_column_gmm"]

@@ -1,0 +1,121 @@
+"""Per-column Bayesian Gaussian mixtures for mode-specific normalization.
+
+The reference fits one sklearn ``BayesianGaussianMixture(n_components=10,
+weight_concentration_prior_type="dirichlet_process",
+weight_concentration_prior=0.001)`` per continuous column (reference
+Server/dtds/features/transformers.py:334-340) and ships the fitted sklearn
+objects over RPC.  Here the mixture is a plain-array dataclass (cheap to
+serialize, usable on device); fitting is sklearn-backed on host by default.
+
+``ColumnGMM`` keeps the fitted sklearn estimator alive (when available) so
+``predict_proba`` matches sklearn's variational posterior exactly during a
+session; the array-only fallback uses standard Gaussian responsibilities.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+N_CLUSTERS = 10
+WEIGHT_EPS = 0.005
+WEIGHT_CONCENTRATION_PRIOR = 0.001
+
+
+@dataclass
+class ColumnGMM:
+    """A 1-D Gaussian mixture as plain arrays.
+
+    means/stds/weights have shape (n_components,); ``active`` is the boolean
+    mask of components whose weight exceeds the activity threshold
+    (reference transformers.py:342-347).
+    """
+
+    means: np.ndarray
+    stds: np.ndarray
+    weights: np.ndarray
+    active: np.ndarray
+    _sk: Optional[object] = field(default=None, repr=False, compare=False)
+
+    @property
+    def n_components(self) -> int:
+        return len(self.means)
+
+    @property
+    def n_active(self) -> int:
+        return int(self.active.sum())
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        """Posterior responsibilities p(k | x); shape (len(x), n_components)."""
+        x = np.asarray(x, dtype=np.float64).reshape(-1)
+        if self._sk is not None:
+            return self._sk.predict_proba(x.reshape(-1, 1))
+        log_w = np.log(np.maximum(self.weights, 1e-300))
+        z = (x[:, None] - self.means[None, :]) / self.stds[None, :]
+        log_p = log_w[None, :] - 0.5 * z**2 - np.log(self.stds)[None, :]
+        log_p -= log_p.max(axis=1, keepdims=True)
+        p = np.exp(log_p)
+        return p / p.sum(axis=1, keepdims=True)
+
+    def sample(self, n: int, rng: np.random.Generator | None = None) -> np.ndarray:
+        """Draw n scalars from the mixture (used by the federated GMM refit,
+        reference Server/dtds/distributed.py:731-735)."""
+        rng = rng or np.random.default_rng()
+        comp = rng.choice(self.n_components, size=n, p=self.weights / self.weights.sum())
+        return rng.normal(self.means[comp], self.stds[comp])
+
+    def to_dict(self) -> dict:
+        return {
+            "means": self.means.tolist(),
+            "stds": self.stds.tolist(),
+            "weights": self.weights.tolist(),
+            "active": self.active.tolist(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ColumnGMM":
+        return cls(
+            means=np.asarray(d["means"], dtype=np.float64),
+            stds=np.asarray(d["stds"], dtype=np.float64),
+            weights=np.asarray(d["weights"], dtype=np.float64),
+            active=np.asarray(d["active"], dtype=bool),
+        )
+
+    @classmethod
+    def from_sklearn(cls, gm, eps: float = WEIGHT_EPS) -> "ColumnGMM":
+        means = np.asarray(gm.means_).reshape(-1)
+        stds = np.sqrt(np.asarray(gm.covariances_)).reshape(-1)
+        weights = np.asarray(gm.weights_).reshape(-1)
+        return cls(
+            means=means,
+            stds=stds,
+            weights=weights,
+            active=weights > eps,
+            _sk=gm,
+        )
+
+
+def fit_column_gmm(
+    x: np.ndarray,
+    n_components: int = N_CLUSTERS,
+    eps: float = WEIGHT_EPS,
+    backend: str = "sklearn",
+    seed: Optional[int] = None,
+) -> ColumnGMM:
+    """Fit a DP Bayesian GMM to one column (host-side, init-time only)."""
+    x = np.asarray(x, dtype=np.float64).reshape(-1, 1)
+    if backend == "sklearn":
+        from sklearn.mixture import BayesianGaussianMixture
+
+        gm = BayesianGaussianMixture(
+            n_components=n_components,
+            weight_concentration_prior_type="dirichlet_process",
+            weight_concentration_prior=WEIGHT_CONCENTRATION_PRIOR,
+            n_init=1,
+            random_state=seed,
+        )
+        gm.fit(x)
+        return ColumnGMM.from_sklearn(gm, eps)
+    raise ValueError(f"unknown backend {backend!r}")

@@ -1,0 +1,16 @@
+from fed_tgan_tpu.models.ctgan import (
+    discriminator_apply,
+    generator_apply,
+    init_discriminator,
+    init_generator,
+)
+from fed_tgan_tpu.models.losses import gradient_penalty, slerp
+
+__all__ = [
+    "discriminator_apply",
+    "generator_apply",
+    "gradient_penalty",
+    "init_discriminator",
+    "init_generator",
+    "slerp",
+]

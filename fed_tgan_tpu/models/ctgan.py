@@ -1,0 +1,146 @@
+"""CTGAN generator/discriminator as parameter pytrees.
+
+Architectures match the reference (Server/dtds/synthesizers/ctgan.py:15-64):
+
+- Generator: residual MLP — each block Linear(d->h) + BatchNorm + ReLU with
+  the input concatenated back on (so widths grow), then Linear(d_total->D).
+- Discriminator: "pac" trick (pac rows concatenated into one sample,
+  reference pac=10) then [Linear + LeakyReLU(0.2) + Dropout(0.5)] blocks and
+  a final Linear(->1).
+
+Plain dict pytrees + pure apply functions (no flax): the federated weighted
+average is then literally ``tree_map(psum(w * p))`` and parameter layouts are
+transparent to shard or serialize.  Initialization follows torch's Linear
+default (U(±1/sqrt(fan_in))) and BatchNorm1d defaults so training dynamics
+match the reference closely.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+BN_EPS = 1e-5  # torch BatchNorm1d defaults
+BN_MOMENTUM = 0.1
+LEAKY_SLOPE = 0.2
+DROPOUT_RATE = 0.5
+PAC = 10
+
+Params = Any  # pytree of jnp arrays
+State = Any
+
+
+def _linear_init(key: jax.Array, fan_in: int, fan_out: int) -> dict:
+    bound = 1.0 / jnp.sqrt(fan_in)
+    wk, bk = jax.random.split(key)
+    return {
+        "w": jax.random.uniform(wk, (fan_in, fan_out), minval=-bound, maxval=bound),
+        "b": jax.random.uniform(bk, (fan_out,), minval=-bound, maxval=bound),
+    }
+
+
+def _linear(p: dict, x: jax.Array) -> jax.Array:
+    return x @ p["w"] + p["b"]
+
+
+# --------------------------------------------------------------- generator
+
+
+def init_generator(
+    key: jax.Array, input_dim: int, hidden: tuple[int, ...], data_dim: int
+) -> tuple[Params, State]:
+    """Residual-MLP generator parameters + batch-norm running state."""
+    params: dict = {"blocks": [], "out": None}
+    state: dict = {"blocks": []}
+    dim = input_dim
+    keys = jax.random.split(key, len(hidden) + 1)
+    for k, h in zip(keys[:-1], hidden):
+        params["blocks"].append(
+            {
+                "fc": _linear_init(k, dim, h),
+                "bn_scale": jnp.ones((h,)),
+                "bn_bias": jnp.zeros((h,)),
+            }
+        )
+        state["blocks"].append(
+            {"mean": jnp.zeros((h,)), "var": jnp.ones((h,))}
+        )
+        dim += h  # residual concat widens the stream
+    params["out"] = _linear_init(keys[-1], dim, data_dim)
+    return params, state
+
+
+def generator_apply(
+    params: Params, state: State, z: jax.Array, train: bool = True
+) -> tuple[jax.Array, State]:
+    """Forward pass; returns (raw output, updated BN state).
+
+    train=True normalizes by batch statistics and advances the running
+    averages (torch BatchNorm1d semantics, incl. unbiased variance in the
+    running update); train=False uses the stored running statistics — the
+    reference samples under ``generator.eval()``
+    (Server/dtds/distributed.py:161)."""
+    x = z
+    new_blocks = []
+    for block, bstate in zip(params["blocks"], state["blocks"]):
+        h = _linear(block["fc"], x)
+        if train:
+            mean = h.mean(axis=0)
+            var = h.var(axis=0)  # biased, used for normalization
+            n = h.shape[0]
+            unbiased = var * n / max(n - 1, 1)
+            new_blocks.append(
+                {
+                    "mean": (1 - BN_MOMENTUM) * bstate["mean"] + BN_MOMENTUM * mean,
+                    "var": (1 - BN_MOMENTUM) * bstate["var"] + BN_MOMENTUM * unbiased,
+                }
+            )
+        else:
+            mean, var = bstate["mean"], bstate["var"]
+            new_blocks.append(bstate)
+        h = (h - mean) / jnp.sqrt(var + BN_EPS)
+        h = h * block["bn_scale"] + block["bn_bias"]
+        h = jax.nn.relu(h)
+        x = jnp.concatenate([h, x], axis=1)
+    out = _linear(params["out"], x)
+    return out, {"blocks": new_blocks}
+
+
+# ----------------------------------------------------------- discriminator
+
+
+def init_discriminator(
+    key: jax.Array, input_dim: int, hidden: tuple[int, ...], pac: int = PAC
+) -> Params:
+    params: dict = {"layers": []}
+    dim = input_dim * pac
+    keys = jax.random.split(key, len(hidden) + 1)
+    for k, h in zip(keys[:-1], hidden):
+        params["layers"].append(_linear_init(k, dim, h))
+        dim = h
+    params["out"] = _linear_init(keys[-1], dim, 1)
+    return params
+
+
+def discriminator_apply(
+    params: Params,
+    x: jax.Array,
+    key: jax.Array | None,
+    pac: int = PAC,
+    train: bool = True,
+) -> jax.Array:
+    """Forward pass; x is (batch, input_dim), batch divisible by pac.
+
+    Dropout(0.5) after every hidden LeakyReLU when train=True; each call
+    needs a fresh ``key`` (torch draws a new mask per forward)."""
+    assert x.shape[0] % pac == 0, (x.shape, pac)
+    h = x.reshape(x.shape[0] // pac, -1)
+    for i, layer in enumerate(params["layers"]):
+        h = jax.nn.leaky_relu(_linear(layer, h), LEAKY_SLOPE)
+        if train:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - DROPOUT_RATE, h.shape)
+            h = jnp.where(keep, h / (1.0 - DROPOUT_RATE), 0.0)
+    return _linear(params["out"], h)

@@ -1,0 +1,3 @@
+from fed_tgan_tpu.ops.segments import SegmentSpec, apply_activate, cond_loss
+
+__all__ = ["SegmentSpec", "apply_activate", "cond_loss"]

@@ -1,0 +1,137 @@
+"""Static segment layout + segment-wise ops for the CTGAN output vector.
+
+The reference walks ``output_info`` with Python loops and dynamic slices at
+every forward (reference Server/dtds/synthesizers/ctgan.py:67-82 apply_activate,
+:174-194 cond_loss).  Dynamic per-segment slicing is hostile to XLA, so here
+the layout is compiled ONCE into static index arrays and every segment op
+becomes a fixed gather/segment_sum — one fused elementwise+reduction kernel
+per call, no per-column Python in the hot loop.
+
+Layout vocabulary (matches the reference):
+- a continuous column contributes a 1-wide 'tanh' segment (the scalar) and an
+  n_active-wide 'softmax' segment (the mode one-hot);
+- a discrete column contributes one 'softmax' segment (category one-hot);
+- the *conditional* vector is the concatenation of ALL softmax segments —
+  including the continuous columns' mode one-hots.  The reference's ``Cond``
+  skips only 'tanh' segments (ctgan.py:107-118), so training-by-sampling can
+  condition on a continuous column being in a particular mode, and
+  ``cond_loss`` covers mode one-hots too.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GUMBEL_TAU = 0.2  # reference ctgan.py:77
+
+
+@dataclass(frozen=True)
+class SegmentSpec:
+    """Static index arrays describing one table's encoded layout.
+
+    All members are host numpy; they become XLA constants when closed over by
+    a jitted function.
+    """
+
+    output_info: tuple  # ((size, kind), ...) — the reference's output_info
+    dim: int  # total encoded width
+    n_segments: int
+    segment_ids: np.ndarray  # (dim,) segment index per feature position
+    is_tanh_dim: np.ndarray  # (dim,) bool
+    # conditional view: every softmax segment, in layout order
+    n_discrete: int  # number of softmax segments (conditional "columns")
+    n_opt: int  # total width of all softmax segments
+    discrete_dims: np.ndarray  # (n_opt,) positions of softmax dims in the data layout
+    cond_column_ids: np.ndarray  # (n_opt,) conditional-column index per cond position
+    cond_offsets: np.ndarray  # (n_discrete,) start of each cond column in cond layout
+    cond_sizes: np.ndarray  # (n_discrete,) width of each cond column
+
+    @classmethod
+    def from_output_info(cls, output_info) -> "SegmentSpec":
+        output_info = tuple((int(s), str(k)) for s, k in output_info)
+        seg_ids, tanh_mask = [], []
+        disc_dims, cond_col_ids, cond_offsets, cond_sizes = [], [], [], []
+        pos = 0
+        n_disc = 0
+        for seg, (size, kind) in enumerate(output_info):
+            seg_ids += [seg] * size
+            tanh_mask += [kind == "tanh"] * size
+            if kind == "softmax":
+                cond_offsets.append(len(disc_dims))
+                cond_sizes.append(size)
+                disc_dims += list(range(pos, pos + size))
+                cond_col_ids += [n_disc] * size
+                n_disc += 1
+            elif kind != "tanh":
+                raise ValueError(f"unknown segment kind {kind!r}")
+            pos += size
+        return cls(
+            output_info=output_info,
+            dim=pos,
+            n_segments=len(output_info),
+            segment_ids=np.asarray(seg_ids, dtype=np.int32),
+            is_tanh_dim=np.asarray(tanh_mask, dtype=bool),
+            n_discrete=n_disc,
+            n_opt=len(disc_dims),
+            discrete_dims=np.asarray(disc_dims, dtype=np.int32),
+            cond_column_ids=np.asarray(cond_col_ids, dtype=np.int32),
+            cond_offsets=np.asarray(cond_offsets, dtype=np.int32),
+            cond_sizes=np.asarray(cond_sizes, dtype=np.int32),
+        )
+
+
+def _segment_softmax(x: jax.Array, segment_ids: np.ndarray, n_segments: int) -> jax.Array:
+    """Row-wise softmax within each segment; x is (batch, dim)."""
+    m = jax.ops.segment_max(x.T, segment_ids, num_segments=n_segments)
+    m = jax.lax.stop_gradient(m)[segment_ids].T
+    e = jnp.exp(x - m)
+    s = jax.ops.segment_sum(e.T, segment_ids, num_segments=n_segments)
+    return e / s[segment_ids].T
+
+
+def apply_activate(data: jax.Array, spec: SegmentSpec, key: jax.Array) -> jax.Array:
+    """tanh on scalar dims, gumbel-softmax (tau=0.2) on one-hot segments.
+
+    Equivalent of reference ctgan.py:67-82 with F.gumbel_softmax semantics
+    (soft sample, no straight-through)."""
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, data.shape) + 1e-20) + 1e-20)
+    noisy = (data + g) / GUMBEL_TAU
+    soft = _segment_softmax(noisy, spec.segment_ids, spec.n_segments)
+    return jnp.where(jnp.asarray(spec.is_tanh_dim), jnp.tanh(data), soft)
+
+
+def segment_argmax_onehot(data: jax.Array, spec: SegmentSpec) -> jax.Array:
+    """Hard version of the softmax segments (used for deterministic decode)."""
+    m = jax.ops.segment_max(data.T, spec.segment_ids, num_segments=spec.n_segments)
+    hard = (data == m[spec.segment_ids].T).astype(data.dtype)
+    return jnp.where(jnp.asarray(spec.is_tanh_dim), jnp.tanh(data), hard)
+
+
+def cond_loss(
+    data: jax.Array, spec: SegmentSpec, cond_vec: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Masked cross-entropy between generated discrete logits and the
+    conditioning one-hot (reference ctgan.py:174-194).
+
+    data: (batch, dim) raw generator output; cond_vec: (batch, n_opt);
+    mask: (batch, n_discrete) — 1 for the column each row conditioned on.
+    """
+    logits = data[:, jnp.asarray(spec.discrete_dims)]  # (batch, n_opt)
+    col_ids = spec.cond_column_ids
+    m = jax.ops.segment_max(
+        jax.lax.stop_gradient(logits).T, col_ids, num_segments=spec.n_discrete
+    )  # (n_discrete, batch)
+    shifted = logits - m[col_ids].T
+    lse = (
+        jnp.log(jax.ops.segment_sum(jnp.exp(shifted).T, col_ids, num_segments=spec.n_discrete))
+        + m
+    ).T  # (batch, n_discrete)
+    target_logit = jax.ops.segment_sum(
+        (logits * cond_vec).T, col_ids, num_segments=spec.n_discrete
+    ).T
+    ce = lse - target_logit
+    return (ce * mask).sum() / data.shape[0]

@@ -1,0 +1,11 @@
+from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
+from fed_tgan_tpu.train.standalone import StandaloneSynthesizer
+from fed_tgan_tpu.train.steps import ModelBundle, TrainConfig
+
+__all__ = [
+    "CondSampler",
+    "ModelBundle",
+    "RowSampler",
+    "StandaloneSynthesizer",
+    "TrainConfig",
+]

@@ -1,0 +1,151 @@
+"""Device-side conditional-vector and real-row samplers.
+
+The reference's ``Cond`` and ``Sampler`` (Server/dtds/synthesizers/ctgan.py:
+102-172, 197-228) are numpy objects with per-row Python loops and ragged
+per-(column, option) index *lists* — unusable under jit.  Here the same
+sampling distributions are compiled into static tables:
+
+- ``CondSampler``: per-discrete-column log-frequency probabilities padded to
+  (n_discrete, max_size); a draw is two vectorized inverse-CDF samples and a
+  scatter — no Python in the loop.
+- ``RowSampler``: rows are bucketed per (column, option) into one flat
+  ``row_pool`` with CSR-style offsets/counts, so "a random row whose column c
+  equals option o" is ``row_pool[offset[o] + floor(u * count[o])]`` — one
+  gather.
+
+Both are registered pytrees (table arrays as leaves, the static
+``SegmentSpec`` as metadata), so the federated runtime can stack per-client
+samplers and shard them along a ``clients`` mesh axis like any other array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fed_tgan_tpu.ops.segments import SegmentSpec
+
+
+@dataclass(frozen=True, eq=False)
+class CondSampler:
+    """Training-by-sampling conditional vectors (reference Cond).
+
+    p_train: log-frequency distribution over options per column
+    (reference ctgan.py:127-137); p_empirical: raw frequency distribution
+    (what ``sample_zero`` draws from via random rows, ctgan.py:163-172).
+    Both are (n_discrete, max_size), zero-padded.
+    """
+
+    p_train: jax.Array
+    p_empirical: jax.Array
+    spec: SegmentSpec
+
+    @classmethod
+    def from_data(cls, data: np.ndarray, spec: SegmentSpec) -> "CondSampler":
+        """data: transformed matrix (rows, spec.dim) with one-hot discrete blocks."""
+        max_size = int(spec.cond_sizes.max()) if spec.n_discrete else 1
+        p_train = np.zeros((max(spec.n_discrete, 1), max_size))
+        p_emp = np.zeros((max(spec.n_discrete, 1), max_size))
+        for c in range(spec.n_discrete):
+            dims = spec.discrete_dims[
+                spec.cond_offsets[c] : spec.cond_offsets[c] + spec.cond_sizes[c]
+            ]
+            freq = data[:, dims].sum(axis=0)
+            logf = np.log(freq + 1.0)
+            p_train[c, : len(dims)] = logf / logf.sum()
+            p_emp[c, : len(dims)] = freq / max(freq.sum(), 1.0)
+        return cls(p_train=jnp.asarray(p_train), p_empirical=jnp.asarray(p_emp), spec=spec)
+
+    def _draw(self, key: jax.Array, batch: int, probs: jax.Array):
+        kcol, kopt = jax.random.split(key)
+        col = jax.random.randint(kcol, (batch,), 0, self.spec.n_discrete)
+        p = probs[col]  # (batch, max_size)
+        r = jax.random.uniform(kopt, (batch, 1))
+        opt = (jnp.cumsum(p, axis=1) > r).argmax(axis=1)
+        return col, opt
+
+    def sample_train(self, key: jax.Array, batch: int):
+        """Returns (cond_vec (batch, n_opt), mask (batch, n_discrete), col, opt)."""
+        col, opt = self._draw(key, batch, self.p_train)
+        pos = jnp.asarray(self.spec.cond_offsets)[col] + opt
+        cond = jnp.zeros((batch, self.spec.n_opt)).at[jnp.arange(batch), pos].set(1.0)
+        mask = jnp.zeros((batch, self.spec.n_discrete)).at[jnp.arange(batch), col].set(1.0)
+        return cond, mask, col, opt
+
+    def sample_empirical(self, key: jax.Array, batch: int) -> jax.Array:
+        """Generation-time conditional draws from the empirical frequency
+        (reference sample_zero)."""
+        col, opt = self._draw(key, batch, self.p_empirical)
+        pos = jnp.asarray(self.spec.cond_offsets)[col] + opt
+        return jnp.zeros((batch, self.spec.n_opt)).at[jnp.arange(batch), pos].set(1.0)
+
+
+@dataclass(frozen=True, eq=False)
+class RowSampler:
+    """Class-conditional real-row sampling (reference Sampler).
+
+    row_pool: (n_discrete * n_rows,) row indices grouped by (column, option);
+    offsets/counts: (n_opt,) CSR pointers into row_pool.  n_rows is carried
+    as a scalar array so shards of different true sizes can share one shape
+    after padding.
+    """
+
+    row_pool: jax.Array
+    offsets: jax.Array
+    counts: jax.Array
+    n_rows: jax.Array
+    spec: SegmentSpec
+
+    @classmethod
+    def from_data(cls, data: np.ndarray, spec: SegmentSpec) -> "RowSampler":
+        pools, offsets, counts = [], [], []
+        cursor = 0
+        for c in range(spec.n_discrete):
+            dims = spec.discrete_dims[
+                spec.cond_offsets[c] : spec.cond_offsets[c] + spec.cond_sizes[c]
+            ]
+            slots = data[:, dims].argmax(axis=1)
+            order = np.argsort(slots, kind="stable")
+            cnt = np.bincount(slots, minlength=len(dims))
+            pools.append(order)
+            starts = cursor + np.concatenate([[0], np.cumsum(cnt)[:-1]])
+            offsets.extend(starts.tolist())
+            counts.extend(cnt.tolist())
+            cursor += len(data)
+        row_pool = (
+            np.concatenate(pools).astype(np.int32) if pools else np.zeros(1, np.int32)
+        )
+        return cls(
+            row_pool=jnp.asarray(row_pool),
+            offsets=jnp.asarray(np.asarray(offsets, dtype=np.int32)),
+            counts=jnp.asarray(np.asarray(counts, dtype=np.int32)),
+            n_rows=jnp.asarray(len(data), dtype=jnp.int32),
+            spec=spec,
+        )
+
+    def sample_rows(self, key: jax.Array, col: jax.Array, opt: jax.Array) -> jax.Array:
+        """Row indices matching (col, opt) pairs; uniform within the bucket.
+
+        Empty buckets cannot occur for options observed on this shard — the
+        conditional sampler only draws options with nonzero frequency."""
+        o = jnp.asarray(self.spec.cond_offsets)[col] + opt
+        cnt = jnp.maximum(self.counts[o], 1)
+        u = jax.random.uniform(key, col.shape)
+        pos = self.offsets[o] + (u * cnt).astype(jnp.int32)
+        return self.row_pool[pos]
+
+    def sample_uniform(self, key: jax.Array, batch: int) -> jax.Array:
+        return jax.random.randint(key, (batch,), 0, self.n_rows)
+
+
+jax.tree_util.register_dataclass(
+    CondSampler, data_fields=["p_train", "p_empirical"], meta_fields=["spec"]
+)
+jax.tree_util.register_dataclass(
+    RowSampler,
+    data_fields=["row_pool", "offsets", "counts", "n_rows"],
+    meta_fields=["spec"],
+)

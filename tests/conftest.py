@@ -7,10 +7,15 @@ SPMD partitioning and collective lowering paths.
 
 import os
 
-# Must be set before jax is imported anywhere.
+# XLA reads this when the CPU client is created, which is late enough.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# This environment pre-imports jax at interpreter startup (a site .pth hook)
+# with JAX_PLATFORMS=axon already set, so the env-var route is too late —
+# override through the config API before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pandas as pd  # noqa: E402

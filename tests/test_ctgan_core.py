@@ -1,0 +1,229 @@
+"""Numeric parity of the JAX CTGAN core against torch equivalents.
+
+These tests build small torch modules with the SAME weights as the JAX
+pytrees and require agreement to float tolerance — catching subtle semantic
+drift (BN variants, CE reductions, interpolation math) that shape tests miss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from fed_tgan_tpu.models.ctgan import (
+    discriminator_apply,
+    generator_apply,
+    init_discriminator,
+    init_generator,
+)
+from fed_tgan_tpu.models.losses import gradient_penalty, slerp
+from fed_tgan_tpu.ops.segments import SegmentSpec, apply_activate, cond_loss
+
+OUT_INFO = [(1, "tanh"), (3, "softmax"), (4, "softmax"), (1, "tanh"), (2, "softmax"), (5, "softmax")]
+
+
+def test_segment_spec_layout():
+    spec = SegmentSpec.from_output_info(OUT_INFO)
+    assert spec.dim == 16
+    assert spec.n_segments == 6
+    # EVERY softmax segment is a conditional column — the reference's Cond
+    # skips only tanh segments, so mode one-hots are conditioned on too
+    assert spec.n_discrete == 4
+    assert spec.n_opt == 14
+    assert spec.discrete_dims.tolist() == [1, 2, 3, 4, 5, 6, 7, 9, 10, 11, 12, 13, 14, 15]
+    assert spec.cond_column_ids.tolist() == [0] * 3 + [1] * 4 + [2] * 2 + [3] * 5
+    assert spec.cond_offsets.tolist() == [0, 3, 7, 9]
+    assert spec.cond_sizes.tolist() == [3, 4, 2, 5]
+
+
+def test_apply_activate_structure():
+    spec = SegmentSpec.from_output_info(OUT_INFO)
+    x = jax.random.normal(jax.random.key(0), (32, spec.dim))
+    y = apply_activate(x, spec, jax.random.key(1))
+    y = np.asarray(y)
+    # tanh dims exactly tanh
+    assert np.allclose(y[:, 0], np.tanh(np.asarray(x)[:, 0]), atol=1e-6)
+    assert np.allclose(y[:, 8], np.tanh(np.asarray(x)[:, 8]), atol=1e-6)
+    # every softmax segment sums to 1 and is in (0,1)
+    for st, size in [(1, 3), (4, 4), (9, 2), (11, 5)]:
+        block = y[:, st : st + size]
+        assert np.allclose(block.sum(axis=1), 1.0, atol=1e-5)
+        assert (block >= 0).all()
+
+
+def test_cond_loss_matches_torch():
+    spec = SegmentSpec.from_output_info(OUT_INFO)
+    rng = np.random.default_rng(0)
+    b = 40
+    data = rng.normal(size=(b, spec.dim)).astype(np.float32)
+    # random conditional vector + mask
+    cond = np.zeros((b, spec.n_opt), dtype=np.float32)
+    mask = np.zeros((b, spec.n_discrete), dtype=np.float32)
+    for i in range(b):
+        col = rng.integers(spec.n_discrete)
+        off, size = spec.cond_offsets[col], spec.cond_sizes[col]
+        cond[i, off + rng.integers(size)] = 1
+        mask[i, col] = 1
+
+    got = float(cond_loss(jnp.asarray(data), spec, jnp.asarray(cond), jnp.asarray(mask)))
+
+    # independent torch computation, reference semantics (ctgan.py:174-194):
+    # every softmax segment contributes a CE term
+    t = torch.tensor(data)
+    losses = []
+    st, st_c = 0, 0
+    for size, kind in OUT_INFO:
+        if kind == "tanh":
+            st += size
+            continue
+        tgt = torch.tensor(cond[:, st_c : st_c + size]).argmax(dim=1)
+        losses.append(F.cross_entropy(t[:, st : st + size], tgt, reduction="none"))
+        st_c += size
+        st += size
+    want = float((torch.stack(losses, dim=1) * torch.tensor(mask)).sum() / b)
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def _copy_gen_to_torch(params):
+    blocks = []
+    for blk in params["blocks"]:
+        fc_w = np.asarray(blk["fc"]["w"])
+        lin = torch.nn.Linear(fc_w.shape[0], fc_w.shape[1])
+        lin.weight.data = torch.tensor(fc_w.T)
+        lin.bias.data = torch.tensor(np.asarray(blk["fc"]["b"]))
+        bn = torch.nn.BatchNorm1d(fc_w.shape[1])
+        bn.weight.data = torch.tensor(np.asarray(blk["bn_scale"]))
+        bn.bias.data = torch.tensor(np.asarray(blk["bn_bias"]))
+        blocks.append((lin, bn))
+    out_w = np.asarray(params["out"]["w"])
+    out = torch.nn.Linear(out_w.shape[0], out_w.shape[1])
+    out.weight.data = torch.tensor(out_w.T)
+    out.bias.data = torch.tensor(np.asarray(params["out"]["b"]))
+    return blocks, out
+
+
+def test_generator_forward_matches_torch_batchnorm():
+    params, state = init_generator(jax.random.key(0), 12, (16, 16), 7)
+    z = np.random.default_rng(1).normal(size=(20, 12)).astype(np.float32)
+
+    got, new_state = generator_apply(params, state, jnp.asarray(z), train=True)
+
+    blocks, out = _copy_gen_to_torch(params)
+    x = torch.tensor(z)
+    for lin, bn in blocks:
+        bn.train()
+        h = torch.relu(bn(lin(x)))
+        x = torch.cat([h, x], dim=1)
+    want = out(x).detach().numpy()
+    assert np.allclose(np.asarray(got), want, atol=1e-4)
+    # running stats advanced identically (torch momentum 0.1, unbiased var)
+    assert np.allclose(
+        np.asarray(new_state["blocks"][0]["mean"]),
+        blocks[0][1].running_mean.numpy(),
+        atol=1e-5,
+    )
+    assert np.allclose(
+        np.asarray(new_state["blocks"][0]["var"]),
+        blocks[0][1].running_var.numpy(),
+        atol=1e-5,
+    )
+
+    # eval mode uses running stats
+    got_eval, _ = generator_apply(params, new_state, jnp.asarray(z), train=False)
+    for lin, bn in blocks:
+        bn.eval()
+    x = torch.tensor(z)
+    for lin, bn in blocks:
+        x = torch.cat([torch.relu(bn(lin(x))), x], dim=1)
+    want_eval = out(x).detach().numpy()
+    assert np.allclose(np.asarray(got_eval), want_eval, atol=1e-4)
+
+
+def _copy_disc_to_torch(params):
+    layers = []
+    for layer in params["layers"]:
+        w = np.asarray(layer["w"])
+        lin = torch.nn.Linear(w.shape[0], w.shape[1])
+        lin.weight.data = torch.tensor(w.T)
+        lin.bias.data = torch.tensor(np.asarray(layer["b"]))
+        layers.append(lin)
+    w = np.asarray(params["out"]["w"])
+    out = torch.nn.Linear(w.shape[0], w.shape[1])
+    out.weight.data = torch.tensor(w.T)
+    out.bias.data = torch.tensor(np.asarray(params["out"]["b"]))
+    return layers, out
+
+
+def _torch_disc_forward(layers, out, x, pac=4):
+    h = x.view(x.shape[0] // pac, -1)
+    for lin in layers:
+        h = F.leaky_relu(lin(h), 0.2)
+    return out(h)
+
+
+def test_discriminator_forward_matches_torch():
+    params = init_discriminator(jax.random.key(2), 10, (8, 8), pac=4)
+    x = np.random.default_rng(3).normal(size=(16, 10)).astype(np.float32)
+    got = discriminator_apply(params, jnp.asarray(x), key=None, pac=4, train=False)
+    layers, out = _copy_disc_to_torch(params)
+    want = _torch_disc_forward(layers, out, torch.tensor(x)).detach().numpy()
+    assert got.shape == (4, 1)
+    assert np.allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_slerp_matches_torch_reference_math():
+    rng = np.random.default_rng(4)
+    low = rng.normal(size=(6, 5)).astype(np.float32)
+    high = rng.normal(size=(6, 5)).astype(np.float32)
+    val = rng.random((6, 1)).astype(np.float32)
+    got = np.asarray(slerp(jnp.asarray(val), jnp.asarray(low), jnp.asarray(high)))
+
+    tl, th, tv = torch.tensor(low), torch.tensor(high), torch.tensor(val)
+    ln = tl / torch.norm(tl, dim=1, keepdim=True)
+    hn = th / torch.norm(th, dim=1, keepdim=True)
+    omega = torch.acos((ln * hn).sum(1)).view(6, 1)
+    so = torch.sin(omega)
+    want = ((torch.sin((1.0 - tv) * omega) / so) * tl + (torch.sin(tv * omega) / so) * th).numpy()
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_gradient_penalty_matches_torch():
+    pac = 4
+    params = init_discriminator(jax.random.key(5), 6, (8,), pac=pac)
+    rng = np.random.default_rng(6)
+    real = rng.normal(size=(8, 6)).astype(np.float32)
+    fake = rng.normal(size=(8, 6)).astype(np.float32)
+    alpha = rng.random((8, 1)).astype(np.float32)
+
+    # jax value with fixed alpha (bypass the rng draw)
+    interp = slerp(jnp.asarray(alpha), jnp.asarray(real), jnp.asarray(fake))
+    d_fn = lambda x: discriminator_apply(params, x, key=None, pac=pac, train=False)
+    grads = jax.grad(lambda x: d_fn(x).sum())(interp)
+    norms = jnp.linalg.norm(grads.reshape(-1, pac * 6), axis=1)
+    got = float(((norms - 1.0) ** 2).mean() * 10.0)
+
+    layers, out = _copy_disc_to_torch(params)
+    tl, th = torch.tensor(real), torch.tensor(fake)
+    tv = torch.tensor(alpha)
+    ln = tl / torch.norm(tl, dim=1, keepdim=True)
+    hn = th / torch.norm(th, dim=1, keepdim=True)
+    omega = torch.acos((ln * hn).sum(1)).view(8, 1)
+    so = torch.sin(omega)
+    ti = ((torch.sin((1.0 - tv) * omega) / so) * tl + (torch.sin(tv * omega) / so) * th)
+    ti.requires_grad_(True)
+    di = _torch_disc_forward(layers, out, ti, pac)
+    g = torch.autograd.grad(di, ti, torch.ones_like(di), create_graph=True)[0]
+    want = float((((g.view(-1, pac * 6).norm(2, dim=1) - 1) ** 2).mean() * 10.0))
+    assert got == pytest.approx(want, rel=1e-4)
+
+
+def test_gradient_penalty_runs_with_rng():
+    pac = 2
+    params = init_discriminator(jax.random.key(7), 4, (8,), pac=pac)
+    real = jax.random.normal(jax.random.key(8), (6, 4))
+    fake = jax.random.normal(jax.random.key(9), (6, 4))
+    d_fn = lambda x: discriminator_apply(params, x, key=jax.random.key(10), pac=pac, train=True)
+    pen = gradient_penalty(d_fn, real, fake, jax.random.key(11), pac=pac)
+    assert np.isfinite(float(pen))

@@ -1,0 +1,113 @@
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.data.encoders import CategoryEncoder
+from fed_tgan_tpu.data.schema import TableMeta
+from fed_tgan_tpu.features.bgm import ColumnGMM, fit_column_gmm
+from fed_tgan_tpu.features.transformer import ModeNormalizer
+
+
+@pytest.fixture(scope="module")
+def bimodal():
+    rng = np.random.default_rng(0)
+    n = 2000
+    return np.concatenate(
+        [rng.normal(-5.0, 0.3, n // 2), rng.normal(4.0, 1.0, n - n // 2)]
+    )
+
+
+def test_bgm_finds_two_modes(bimodal):
+    gmm = fit_column_gmm(bimodal, seed=0)
+    assert gmm.n_components == 10
+    # DP prior with wcp=0.001 should concentrate on ~2 active modes
+    assert 2 <= gmm.n_active <= 4
+    active_means = np.sort(gmm.means[gmm.active])
+    assert abs(active_means[0] - (-5.0)) < 0.5
+    assert abs(active_means[-1] - 4.0) < 0.5
+
+
+def test_bgm_roundtrip_serialization(bimodal):
+    gmm = fit_column_gmm(bimodal, seed=0)
+    rt = ColumnGMM.from_dict(gmm.to_dict())
+    assert np.allclose(rt.means, gmm.means)
+    # fallback responsibilities are a valid distribution
+    p = rt.predict_proba(np.array([-5.0, 4.0]))
+    assert p.shape == (2, 10)
+    assert np.allclose(p.sum(axis=1), 1.0)
+    # each point assigned overwhelmingly to its own mode
+    assert p[0].argmax() != p[1].argmax()
+
+
+def test_bgm_sample_matches_distribution(bimodal):
+    gmm = fit_column_gmm(bimodal, seed=0)
+    s = gmm.sample(4000, np.random.default_rng(1))
+    # two-cluster structure preserved
+    assert (s < 0).mean() == pytest.approx(0.5, abs=0.05)
+
+
+def test_transform_layout_and_inverse(bimodal):
+    rng = np.random.default_rng(3)
+    n = len(bimodal)
+    codes = rng.choice([0, 1, 2], n, p=[0.5, 0.3, 0.2])
+    data = np.stack([bimodal, codes.astype(float)], axis=1)
+
+    tf = ModeNormalizer(seed=0).fit(data, categorical_idx=[1])
+    kinds = [k for _, k in tf.output_info]
+    assert kinds[0] == "tanh" and kinds[1] == "softmax" and kinds[2] == "softmax"
+    assert tf.output_info[2][0] == 3
+    assert tf.output_dim == 1 + tf.output_info[1][0] + 3
+
+    enc = tf.transform(data, rng=np.random.default_rng(0))
+    assert enc.shape == (n, tf.output_dim)
+    assert enc.dtype == np.float32
+    # scalar features clipped into (-1, 1)
+    assert np.abs(enc[:, 0]).max() <= 0.99
+    # one-hot blocks sum to one
+    assert np.allclose(enc[:, 1 : 1 + tf.output_info[1][0]].sum(axis=1), 1.0)
+    assert np.allclose(enc[:, -3:].sum(axis=1), 1.0)
+
+    dec = tf.inverse_transform(enc)
+    # categorical round-trips exactly
+    assert (dec[:, 1] == codes).all()
+    # continuous reconstruction is close
+    assert np.corrcoef(dec[:, 0], bimodal)[0, 1] > 0.99
+    assert np.abs(dec[:, 0] - bimodal).mean() < 0.5
+
+
+def test_discrete_slots_are_frequency_ordered():
+    col = np.array([2, 2, 2, 0, 0, 1], dtype=float)[:, None]
+    tf = ModeNormalizer().fit(col, categorical_idx=[0])
+    assert tf.columns[0].codes.tolist() == [2, 0, 1]
+    enc = tf.transform(col)
+    # most frequent code (2) occupies slot 0
+    assert enc[0].tolist() == [1.0, 0.0, 0.0]
+
+
+def test_refit_with_global_agrees_across_clients(bimodal):
+    # two clients with differently-ordered local categories
+    rng = np.random.default_rng(5)
+    n = len(bimodal)
+    half = n // 2
+    codes = np.concatenate(
+        [rng.choice([0, 1], half, p=[0.9, 0.1]), rng.choice([0, 1], half, p=[0.1, 0.9])]
+    )
+    data = np.stack([bimodal, codes.astype(float)], axis=1)
+
+    global_gmm = fit_column_gmm(bimodal, seed=0)
+    enc = CategoryEncoder.fit(["a", "b"])
+    meta = TableMeta.from_json_dict(
+        {
+            "columns": [
+                {"column_name": "x", "type": "continous", "min": -6, "max": 7, "column no": 0},
+                {"column_name": "c", "type": "categorical", "size": 2, "i2s": ["b", "a"], "column no": 1},
+            ]
+        }
+    )
+    tfs = []
+    for sl in (slice(0, half), slice(half, n)):
+        tf = ModeNormalizer().refit_with_global(meta, [enc], [None, global_gmm][::-1])
+        tfs.append(tf)
+    assert tfs[0].output_dim == tfs[1].output_dim
+    assert tfs[0].output_info == tfs[1].output_info
+    # global i2s order 'b','a' -> slot 0 holds code of 'b' (=1)
+    assert tfs[0].columns[1].codes.tolist() == [1, 0]
