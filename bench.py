@@ -1,0 +1,74 @@
+"""Benchmark: federated Intrusion training, seconds per round.
+
+Reproduces the reference's demo workload shape (README.md:44-54): Intrusion
+schema, 2 participants (world_size 3), full CTGAN config (batch 500,
+dims 256x256, pac 10), one epoch = every client's local steps + weighted
+FedAvg + a 40,000-row synthetic snapshot decoded to raw format — the same
+work the reference times at ~24.26 s/epoch over PyTorch-RPC/Gloo on CPU.
+
+Data: the repo's surviving real table (Intrusion_test.csv, 10,098 rows; the
+train CSV was stripped from the snapshot).  Prints ONE JSON line:
+value = seconds per round (median of measured rounds, post-compile);
+vs_baseline = baseline_seconds / value (higher is better).
+"""
+
+import json
+import sys
+import time
+
+BASELINE_EPOCH_SECONDS = 24.26  # reference README.md:53 (cumulative @ epoch 0)
+
+
+def main() -> int:
+    import numpy as np
+
+    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.datasets import INTRUSION, preprocessor_kwargs
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    import pandas as pd
+
+    csv_path = "/root/reference/Server/data/raw/Intrusion_test.csv"
+    df = pd.read_csv(csv_path)
+
+    kwargs = preprocessor_kwargs(INTRUSION)
+    selected = kwargs.pop("selected_columns")
+    frames = shard_dataframe(df, 2, "iid", seed=0)
+    clients = [
+        TablePreprocessor(frame=f, name="Intrusion", selected_columns=selected, **kwargs)
+        for f in frames
+    ]
+
+    init = federated_initialize(clients, seed=0)
+    trainer = FederatedTrainer(init, config=TrainConfig(), seed=0)
+
+    def run_round() -> float:
+        t0 = time.time()
+        trainer.fit(1)
+        decoded = trainer.sample(40000, seed=1)
+        decode_matrix(decoded, init.global_meta, init.encoders)
+        return time.time() - t0
+
+    run_round()  # compile warmup
+    times = [run_round() for _ in range(3)]
+    value = float(np.median(times))
+
+    print(
+        json.dumps(
+            {
+                "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)",
+                "value": round(value, 4),
+                "unit": "s/round",
+                "vs_baseline": round(BASELINE_EPOCH_SECONDS / value, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,227 @@
+"""Command-line entry point.
+
+The reference is launched as ``python3 -m dtds.distributed -rank K ...`` once
+per process (reference Server/dtds/distributed.py:894-955; README.md:10-14).
+The SPMD redesign needs ONE launch: participants live on mesh positions, so
+``--n-clients 8`` replaces world_size bookkeeping, and ``--backend`` selects
+tpu (default: whatever jax finds) or a cpu mesh with virtual devices.
+
+Reference-style ``-rank``/``-world_size`` flags are accepted for drop-in
+compatibility: rank != 0 exits immediately (there are no client processes to
+start), world_size maps to n-clients = world_size - 1.
+
+Outputs mirror the reference layout so similarity_analysis.py /
+utility_analysis.py work unchanged:
+  <out>/<name>_result/<name>_synthesis_epoch_<i>.csv   per-epoch snapshots
+  <out>/timestamp_experiment.csv                       per-epoch wall-clock
+  <out>/models/<name>.json                             harmonized meta
+  <out>/models/label_encoders_<name>.pickle            global encoders
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import pickle
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="fed_tgan_tpu", description=__doc__)
+    p.add_argument("-datapath", "--datapath", type=str, required=False,
+                   default="data/raw/Intrusion_train.csv")
+    p.add_argument("--client-data", type=str, nargs="*", default=None,
+                   help="per-client CSVs (true federated layout); overrides --datapath sharding")
+    p.add_argument("--dataset", type=str, default="intrusion",
+                   help="schema preset: intrusion|adult|covertype|custom")
+    p.add_argument("--categorical", type=str, nargs="*", default=None)
+    p.add_argument("--non-negative", type=str, nargs="*", default=None)
+    p.add_argument("--target-column", type=str, default=None)
+    p.add_argument("--problem-type", type=str, default=None)
+    p.add_argument("-epochs", "--epochs", type=int, default=10)
+    p.add_argument("--n-clients", type=int, default=None)
+    p.add_argument("--shard-strategy", type=str, default="iid",
+                   choices=["iid", "contiguous", "label_sorted", "dirichlet"])
+    p.add_argument("--alpha", type=float, default=0.5, help="dirichlet skew")
+    p.add_argument("--uniform", action="store_true",
+                   help="uniform FedAvg instead of similarity-weighted")
+    p.add_argument("--backend", type=str, default=None, choices=[None, "tpu", "cpu"],
+                   help="cpu = virtual-device mesh (see --n-virtual-devices)")
+    p.add_argument("--n-virtual-devices", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=500)
+    p.add_argument("--embedding-dim", type=int, default=128)
+    p.add_argument("--sample-rows", type=int, default=40000)
+    p.add_argument("--sample-every", type=int, default=1,
+                   help="epochs between synthetic snapshots; 0 = only at end")
+    p.add_argument("--out-dir", type=str, default=".")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--eval", action="store_true",
+                   help="run similarity analysis against the training data at the end")
+    p.add_argument("--quiet", action="store_true")
+    # reference-compatible world bookkeeping (ignored in SPMD mode)
+    p.add_argument("-rank", "--rank", type=int, default=None)
+    p.add_argument("-world_size", "--world_size", type=int, default=None)
+    p.add_argument("-ip", "--ip", type=str, default=None)
+    p.add_argument("-port", "--port", type=int, default=None)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.rank is not None and args.rank != 0:
+        print(
+            "fed_tgan_tpu runs all participants inside one SPMD program; "
+            f"rank {args.rank} has no separate process to start. Launch only "
+            "rank 0 (or omit -rank)."
+        )
+        return 0
+
+    if args.backend == "cpu":
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            f"--xla_force_host_platform_device_count={args.n_virtual_devices}",
+        )
+    import jax
+
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import pandas as pd
+
+    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.datasets import PRESETS, preprocessor_kwargs
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    if args.dataset != "custom" and args.dataset not in PRESETS:
+        print(f"unknown dataset preset {args.dataset!r}; use {sorted(PRESETS)} or 'custom'")
+        return 2
+
+    if args.dataset == "custom":
+        kwargs = dict(
+            categorical_columns=args.categorical or [],
+            non_negative_columns=args.non_negative or [],
+            target_column=args.target_column or "",
+            problem_type=args.problem_type or "",
+        )
+        name = os.path.basename(args.datapath).rsplit(".", 1)[0]
+    else:
+        preset = PRESETS[args.dataset]
+        kwargs = preprocessor_kwargs(preset)
+        for flag, kw in [
+            ("categorical", "categorical_columns"),
+            ("non_negative", "non_negative_columns"),
+            ("target_column", "target_column"),
+            ("problem_type", "problem_type"),
+        ]:
+            v = getattr(args, flag)
+            if v is not None:
+                kwargs[kw] = v
+        name = preset.name
+
+    n_clients = args.n_clients
+    if n_clients is None:
+        n_clients = (args.world_size - 1) if args.world_size else len(jax.devices())
+
+    t_init = time.time()
+    if args.client_data:
+        frames = [pd.read_csv(p) for p in args.client_data]
+        n_clients = len(frames)
+    else:
+        df = pd.read_csv(args.datapath)
+        label_col = kwargs.get("target_column") or None
+        frames = shard_dataframe(
+            df,
+            n_clients,
+            args.shard_strategy,
+            label_column=label_col if args.shard_strategy in ("label_sorted", "dirichlet") else None,
+            alpha=args.alpha,
+            seed=args.seed,
+        )
+
+    selected = kwargs.pop("selected_columns", None)
+    clients = [
+        TablePreprocessor(
+            frame=f,
+            name=name,
+            selected_columns=[c for c in (selected or f.columns) if c in f.columns],
+            **kwargs,
+        )
+        for f in frames
+    ]
+
+    if not args.quiet:
+        print(f"{n_clients} clients, rows per shard: {[c.n_rows for c in clients]}")
+        print("running federated initialization (harmonize + GMM refit)...")
+    init = federated_initialize(clients, seed=args.seed, weighted=not args.uniform)
+    if not args.quiet:
+        print(f"init done in {time.time() - t_init:.1f}s; "
+              f"aggregation weights: {np.round(init.weights, 4).tolist()}")
+
+    cfg = TrainConfig(batch_size=args.batch_size, embedding_dim=args.embedding_dim)
+    trainer = FederatedTrainer(init, config=cfg, seed=args.seed)
+
+    result_dir = os.path.join(args.out_dir, f"{name}_result")
+    models_dir = os.path.join(args.out_dir, "models")
+    os.makedirs(result_dir, exist_ok=True)
+    os.makedirs(models_dir, exist_ok=True)
+
+    init.global_meta.dump_json(os.path.join(models_dir, f"{name}.json"))
+    with open(os.path.join(models_dir, f"label_encoders_{name}.pickle"), "wb") as f:
+        pickle.dump(
+            [
+                {"column_name": c, "label_encoder": e}
+                for c, e in zip(init.global_meta.categorical_columns, init.encoders)
+            ],
+            f,
+        )
+
+    def snapshot(epoch: int, tr: FederatedTrainer) -> None:
+        decoded = tr.sample(args.sample_rows, seed=args.seed + epoch)
+        raw = decode_matrix(decoded, init.global_meta, init.encoders)
+        raw.to_csv(
+            os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv"),
+            index=False,
+        )
+
+    hook = None
+    if args.sample_every:
+        hook = lambda e, tr: snapshot(e, tr) if e % args.sample_every == 0 else None
+
+    trainer.fit(args.epochs, log_every=0 if args.quiet else max(1, args.epochs // 10),
+                sample_hook=hook)
+    if args.sample_every == 0:
+        snapshot(args.epochs - 1, trainer)
+
+    with open(os.path.join(args.out_dir, "timestamp_experiment.csv"), "w") as f:
+        csv.writer(f).writerows([[t] for t in trainer.epoch_times])
+
+    if args.eval:
+        from fed_tgan_tpu.eval.similarity import statistical_similarity
+
+        full = pd.concat(frames)
+        last_epoch = args.epochs - 1 if args.sample_every else args.epochs - 1
+        fake = pd.read_csv(
+            os.path.join(result_dir, f"{name}_synthesis_epoch_{last_epoch}.csv")
+        )
+        avg_jsd, avg_wd, _ = statistical_similarity(
+            full, fake, kwargs["categorical_columns"]
+        )
+        print(f"final Avg_JSD={avg_jsd:.4f} Avg_WD={avg_wd:.4f}")
+
+    if not args.quiet:
+        total = sum(trainer.epoch_times)
+        print(f"{args.epochs} rounds in {total:.1f}s "
+              f"({total / max(args.epochs, 1):.3f}s/round)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

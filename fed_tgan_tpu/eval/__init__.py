@@ -1,0 +1,4 @@
+from fed_tgan_tpu.eval.similarity import statistical_similarity
+from fed_tgan_tpu.eval.utility import ml_utility, utility_difference
+
+__all__ = ["ml_utility", "statistical_similarity", "utility_difference"]

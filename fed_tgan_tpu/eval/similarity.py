@@ -1,0 +1,82 @@
+"""Statistical similarity between a real and a synthetic table.
+
+Same metric definitions as the reference's offline script
+(reference Server/similarity_analysis.py:15-82):
+
+- categorical column -> Jensen-Shannon distance (base 2) between category
+  frequency vectors, real categories absent from the fake side contributing
+  zeros;
+- continuous column -> Wasserstein distance after min-max scaling fitted on
+  the REAL column;
+- averages reported per kind (Avg_JSD, Avg_WD).
+
+Output CSV format matches the reference's
+``*_statistical_similarity_analysis.csv`` so downstream tooling is
+unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+from scipy.spatial import distance as _sdistance
+from scipy.stats import wasserstein_distance
+
+
+def column_similarity(
+    real: pd.Series, fake: pd.Series, categorical: bool
+) -> float:
+    if categorical:
+        real_counts = real.astype(str).value_counts(normalize=True)
+        fake_counts = fake.astype(str).value_counts(normalize=True)
+        cats = sorted(real_counts.index.tolist())
+        p = [real_counts[c] for c in cats]
+        q = [fake_counts.get(c, 0.0) for c in cats]
+        # fake-only categories contribute no real mass; the reference ignores
+        # them the same way (fake categories outside the real vocabulary do
+        # not appear in its sorted_categories walk)
+        return float(_sdistance.jensenshannon(p, q, 2.0))
+    r = real.astype(float).to_numpy()
+    f = fake.astype(float).to_numpy()
+    lo, hi = r.min(), r.max()
+    span = hi - lo if hi > lo else 1.0
+    return float(wasserstein_distance((r - lo) / span, (f - lo) / span))
+
+
+def statistical_similarity(
+    real: pd.DataFrame,
+    fake: pd.DataFrame,
+    categorical_columns: Sequence[str],
+) -> tuple[float, float, dict]:
+    """Returns (avg_jsd, avg_wd, per_column)."""
+    cat = set(categorical_columns)
+    per_column = {}
+    for col in real.columns:
+        per_column[col] = column_similarity(real[col], fake[col], col in cat)
+    jsds = [v for c, v in per_column.items() if c in cat]
+    wds = [v for c, v in per_column.items() if c not in cat]
+    avg_jsd = float(np.mean(jsds)) if jsds else float("nan")
+    avg_wd = float(np.mean(wds)) if wds else float("nan")
+    return avg_jsd, avg_wd, per_column
+
+
+def similarity_report(
+    real_path: str,
+    fake_paths: Sequence[str],
+    categorical_columns: Sequence[str],
+    epoch_times: Optional[Sequence[float]] = None,
+) -> pd.DataFrame:
+    """Per-epoch report, column-compatible with the reference script output
+    (Epoch_No., Avg_JSD, Avg_WD, time_stamp cumulative seconds)."""
+    real = pd.read_csv(real_path)
+    rows = []
+    for i, fp in enumerate(fake_paths):
+        fake = pd.read_csv(fp)
+        avg_jsd, avg_wd, _ = statistical_similarity(real, fake, categorical_columns)
+        rows.append([i, avg_jsd, avg_wd])
+    df = pd.DataFrame(rows, columns=["Epoch_No.", "Avg_JSD", "Avg_WD"])
+    if epoch_times is not None:
+        df["time_stamp"] = np.cumsum(np.asarray(epoch_times, dtype=float))
+    return df

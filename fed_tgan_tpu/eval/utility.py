@@ -1,0 +1,99 @@
+"""ML-utility evaluation (train-on-synthetic, test-on-real).
+
+Same protocol as the reference (reference Server/utility_analysis.py:15-119):
+label-encode categoricals on real-train ∪ real-test, StandardScaler fitted on
+the full real table, then LR / DecisionTree / RandomForest / MLP classifiers
+(class_weight balanced where supported, random_state 69); report accuracy and
+weighted F1, and the real-minus-synthetic difference.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import pandas as pd
+
+RANDOM_STATE = 69
+
+
+def ml_utility(
+    reference_frame: pd.DataFrame,
+    train: pd.DataFrame,
+    test: pd.DataFrame,
+    target_column: str,
+    categorical_columns: Sequence[str],
+) -> list[list[float]]:
+    """[ [acc, weighted_f1] for LR, DT, RF, MLP ] trained on ``train``.
+
+    ``reference_frame`` is the union of real train+test — encoders and the
+    scaler are fitted on it (reference utility_analysis.py:32-51)."""
+    from sklearn import ensemble, linear_model, metrics, preprocessing, tree
+    from sklearn.metrics import f1_score
+    from sklearn.neural_network import MLPClassifier
+
+    ref = reference_frame.copy()
+    train = train.copy()
+    test = test.copy()
+
+    for col in categorical_columns:
+        le = preprocessing.LabelEncoder()
+        for df in (ref, train, test):
+            df[col] = df[col].astype(str)
+        le.fit(ref[col].values)
+        for df in (ref, train, test):
+            df[col] = le.transform(df[col])
+
+    y_train = train[target_column]
+    x_train = train.drop(columns=[target_column])
+    y_test = test[target_column]
+    x_test = test.drop(columns=[target_column])
+    ref = ref.drop(columns=[target_column])
+
+    scaler = preprocessing.StandardScaler().fit(ref.values)
+    x_train = scaler.transform(x_train)
+    x_test = scaler.transform(x_test)
+
+    models = [
+        linear_model.LogisticRegression(class_weight="balanced", random_state=RANDOM_STATE),
+        tree.DecisionTreeClassifier(class_weight="balanced", random_state=RANDOM_STATE),
+        ensemble.RandomForestClassifier(class_weight="balanced", random_state=RANDOM_STATE),
+        MLPClassifier(random_state=RANDOM_STATE),
+    ]
+    out = []
+    for model in models:
+        model.fit(x_train, y_train)
+        pred = model.predict(x_test)
+        out.append(
+            [
+                float(metrics.accuracy_score(y_test, pred)),
+                float(f1_score(y_test, pred, average="weighted")),
+            ]
+        )
+    return out
+
+
+def utility_difference(
+    real_train: pd.DataFrame,
+    synthetic: pd.DataFrame,
+    test: pd.DataFrame,
+    target_column: str,
+    categorical_columns: Sequence[str],
+) -> dict:
+    """Real-vs-synthetic utility gap; ``delta_f1`` is the headline number
+    the reference README reports (README.md:67)."""
+    reference_frame = pd.concat([real_train, test])
+    real_u = np.asarray(
+        ml_utility(reference_frame, real_train, test, target_column, categorical_columns)
+    )
+    fake_u = np.asarray(
+        ml_utility(reference_frame, synthetic, test, target_column, categorical_columns)
+    )
+    diff = real_u - fake_u
+    return {
+        "real": real_u.tolist(),
+        "synthetic": fake_u.tolist(),
+        "difference": diff.tolist(),
+        "delta_accuracy": float(diff.mean(axis=0)[0]),
+        "delta_f1": float(diff.mean(axis=0)[1]),
+    }

@@ -106,6 +106,10 @@ def fit_column_gmm(
 ) -> ColumnGMM:
     """Fit a DP Bayesian GMM to one column (host-side, init-time only)."""
     x = np.asarray(x, dtype=np.float64).reshape(-1, 1)
+    # degenerate shards: a mixture can't have more components than samples.
+    # Only local (per-client) fits can be this small; the global refit pools
+    # all clients, so output dims are unaffected.
+    n_components = max(1, min(n_components, len(x)))
     if backend == "sklearn":
         from sklearn.mixture import BayesianGaussianMixture
 

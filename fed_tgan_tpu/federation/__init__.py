@@ -1,0 +1,15 @@
+from fed_tgan_tpu.federation.init import (
+    FederatedInit,
+    aggregation_weights,
+    federated_initialize,
+    harmonize_categories,
+    harmonize_continuous,
+)
+
+__all__ = [
+    "FederatedInit",
+    "aggregation_weights",
+    "federated_initialize",
+    "harmonize_categories",
+    "harmonize_continuous",
+]

@@ -1,0 +1,233 @@
+"""Host-side federated initialization.
+
+The one-time init phase of Fed-TGAN, exactly the reference's math:
+
+1. **Category harmonization** (reference Server/dtds/distributed.py:592-684
+   ``uniform_meta_category``): merge per-client category frequency dicts,
+   order the global vocabulary by total frequency, fit one label encoder per
+   categorical column, and score every client by per-column Jensen-Shannon
+   distance between its frequency vector and the global one.
+2. **Continuous harmonization** (reference :689-765
+   ``uniform_continuous_gmm``): per continuous column, draw a
+   rows-proportional sample from every client's local GMM, pool them, refit
+   a global Bayesian GMM on the pool, and score every client by Wasserstein
+   distance between its sample and the pool.
+3. **Aggregation weights** (reference :767-783
+   ``calculate_final_weights_for_aggregation``):
+   ``softmax((1 - d_i/sum(d)) * n_i/N)`` where ``d_i`` sums the client's
+   normalized JSD and WD scores.
+
+This phase is object-valued, one-time and cold, so it stays on host
+(numpy + sklearn) exchanged over the runtime transport; only its *outputs*
+(encoded shards, sampler tables, weights) move to the device mesh.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+from scipy.spatial import distance as _sdistance
+from scipy.stats import wasserstein_distance
+
+from fed_tgan_tpu.data.encoders import CategoryEncoder
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.schema import TableMeta
+from fed_tgan_tpu.features.bgm import N_CLUSTERS, WEIGHT_EPS, ColumnGMM, fit_column_gmm
+from fed_tgan_tpu.features.transformer import ModeNormalizer
+
+
+def _normalize_per_column(dist: np.ndarray, n_clients: int) -> np.ndarray:
+    """Reference's per-column normalization incl. the zero-sum fallback
+    (distributed.py:642-657): each column's distances are divided by their
+    sum across clients; all-zero columns (single participant) become
+    1/n_clients for everyone."""
+    dist = dist.astype(np.float64).copy()
+    col_sum = dist.sum(axis=0)
+    nonzero = col_sum != 0
+    dist[:, nonzero] = dist[:, nonzero] / col_sum[nonzero]
+    dist[:, ~nonzero] = 1.0 / n_clients
+    return dist
+
+
+def harmonize_categories(
+    local_metas: Sequence[dict],
+) -> tuple[dict, list[CategoryEncoder], np.ndarray]:
+    """Merge per-client local metas into the harmonized global meta.
+
+    Returns (global_meta_dict, encoders, jsd):
+    - global_meta_dict: first client's meta with each categorical ``i2s``
+      replaced by the globally-frequency-ordered category list;
+    - encoders: one per categorical column, fitted on the global vocabulary;
+    - jsd: (n_clients, n_categorical) per-column normalized JSD scores.
+    """
+    n_clients = len(local_metas)
+    base = copy.deepcopy(local_metas[0])
+    cat_cols = [i for i, c in enumerate(base["columns"]) if c["type"] == "categorical"]
+
+    encoders: list[CategoryEncoder] = []
+    jsd = np.zeros((n_clients, len(cat_cols)))
+
+    for cursor, col_idx in enumerate(cat_cols):
+        merged: dict[str, int] = {}
+        for meta in local_metas:
+            for key, count in meta["columns"][col_idx]["i2s"].items():
+                merged[key] = merged.get(key, 0) + int(count)
+
+        ordered = [k for k, _ in sorted(merged.items(), key=lambda kv: kv[1], reverse=True)]
+        base["columns"][col_idx]["i2s"] = ordered
+        base["columns"][col_idx]["size"] = len(ordered)
+
+        enc = CategoryEncoder.fit(ordered)
+        encoders.append(enc)
+
+        vocab = len(ordered)
+        vec_global = np.zeros(vocab)
+        codes = {k: int(enc.transform([k])[0]) for k in ordered}
+        for key, count in merged.items():
+            vec_global[codes[key]] = count
+
+        for ci, meta in enumerate(local_metas):
+            vec = np.zeros(vocab)
+            for key, count in meta["columns"][col_idx]["i2s"].items():
+                vec[codes[key]] = count
+            jsd[ci, cursor] = _sdistance.jensenshannon(vec_global, vec)
+
+    jsd = np.nan_to_num(jsd, nan=0.0)
+    return base, encoders, _normalize_per_column(jsd, n_clients)
+
+
+def harmonize_continuous(
+    client_gmms: Sequence[Sequence[Optional[ColumnGMM]]],
+    rows_per_client: Sequence[int],
+    seed: int = 0,
+    n_components: int = N_CLUSTERS,
+    eps: float = WEIGHT_EPS,
+    backend: str = "sklearn",
+) -> tuple[list[Optional[ColumnGMM]], np.ndarray]:
+    """Pool rows-proportional samples of the per-client column GMMs, refit
+    global GMMs, and score clients by Wasserstein distance to the pool.
+
+    ``client_gmms[i][j]`` is client i's GMM for column j (None when
+    discrete).  Returns (global_gmms_per_column, wd) where wd is
+    (n_clients, n_continuous) normalized.
+    """
+    n_clients = len(client_gmms)
+    n_cols = len(client_gmms[0])
+    n_sample = int(np.sum(rows_per_client))
+    by_number = [float(r) / n_sample for r in rows_per_client]
+    rng = np.random.default_rng(seed)
+
+    cont_cols = [j for j in range(n_cols) if client_gmms[0][j] is not None]
+    wd = np.zeros((n_clients, len(cont_cols)))
+    global_gmms: list[Optional[ColumnGMM]] = [None] * n_cols
+
+    for cursor, j in enumerate(cont_cols):
+        samples = [
+            client_gmms[i][j].sample(int(n_sample * by_number[i]), rng)
+            for i in range(n_clients)
+        ]
+        pooled = np.concatenate(samples)
+        for i in range(n_clients):
+            wd[i, cursor] = wasserstein_distance(pooled, samples[i])
+        global_gmms[j] = fit_column_gmm(
+            pooled, n_components=n_components, eps=eps, backend=backend, seed=seed
+        )
+
+    return global_gmms, _normalize_per_column(wd, n_clients)
+
+
+def aggregation_weights(
+    jsd: np.ndarray, wd: np.ndarray, rows_per_client: Sequence[int]
+) -> np.ndarray:
+    """``softmax((1 - d_i/sum(d)) * n_i/N)`` — reference distributed.py:767-783."""
+    combo = jsd.sum(axis=1) + wd.sum(axis=1)
+    total = combo.sum()
+    by_number = np.asarray(rows_per_client, dtype=np.float64)
+    by_number = by_number / by_number.sum()
+    raw = (1.0 - combo / total) * by_number
+    e = np.exp(raw)
+    return e / e.sum()
+
+
+@dataclass
+class FederatedInit:
+    """Everything the device-mesh trainer needs after init."""
+
+    global_meta: TableMeta
+    encoders: list[CategoryEncoder]
+    transformers: list[ModeNormalizer]
+    client_matrices: list[np.ndarray]  # transformed (encoded) per-client data
+    weights: np.ndarray  # (n_clients,) aggregation weights
+    jsd: np.ndarray
+    wd: np.ndarray
+    rows_per_client: list[int] = field(default_factory=list)
+
+    @property
+    def output_info(self):
+        return self.transformers[0].output_info
+
+
+def federated_initialize(
+    clients: Sequence[TablePreprocessor],
+    seed: int = 0,
+    backend: str = "sklearn",
+    weighted: bool = True,
+) -> FederatedInit:
+    """Run the full init protocol over in-process client shards.
+
+    Mirrors the server's startup sequence (reference distributed.py:866-874):
+    uniform_meta_category -> uniform_continuous_gmm -> refit_local_transformer
+    -> calculate_final_weights_for_aggregation.  ``weighted=False`` yields
+    uniform FedAvg weights (the reference's ``average_model_ordinary``).
+    """
+    n_clients = len(clients)
+    local_metas = [c.local_meta() for c in clients]
+
+    global_meta_dict, encoders, jsd = harmonize_categories(local_metas)
+
+    encoded = [c.encode(encoders) for c in clients]
+    matrices = [m for m, _, _ in encoded]
+    cat_idx = encoded[0][1]
+    rows_per_client = [len(m) for m in matrices]
+
+    # local per-column GMM fits (client-side in the reference)
+    local_tfs = [
+        ModeNormalizer(backend=backend, seed=seed).fit(m, cat_idx)
+        for m in matrices
+    ]
+    client_gmms = [tf.column_gmms for tf in local_tfs]
+
+    global_gmms, wd = harmonize_continuous(
+        client_gmms, rows_per_client, seed=seed, backend=backend
+    )
+
+    global_meta = TableMeta.from_json_dict(global_meta_dict)
+    transformers = []
+    client_matrices = []
+    for i in range(n_clients):
+        tf = ModeNormalizer(backend=backend, seed=seed).refit_with_global(
+            global_meta, encoders, global_gmms
+        )
+        transformers.append(tf)
+        client_matrices.append(
+            tf.transform(matrices[i], rng=np.random.default_rng(seed + i))
+        )
+
+    if weighted:
+        weights = aggregation_weights(jsd, wd, rows_per_client)
+    else:
+        weights = np.full(n_clients, 1.0 / n_clients)
+
+    return FederatedInit(
+        global_meta=global_meta,
+        encoders=encoders,
+        transformers=transformers,
+        client_matrices=client_matrices,
+        weights=weights,
+        jsd=jsd,
+        wd=wd,
+        rows_per_client=rows_per_client,
+    )

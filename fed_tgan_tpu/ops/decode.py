@@ -1,0 +1,73 @@
+"""On-device inverse transform (encoded layout -> numeric column values).
+
+The reference decodes 40k sampled rows per epoch on the host with per-column
+numpy loops (reference Server/dtds/features/transformers.py:430-464).  Doing
+the argmax + mode-denormalization on device shrinks the device->host
+transfer from (n, encoded_dim) one-hots to (n, n_columns) scalars and fuses
+the whole generation+decode into one XLA program — the per-epoch snapshot
+then costs one host round-trip.
+
+Semantics identical to ``ModeNormalizer.inverse_transform``:
+continuous: ``clip(u,-1,1) * 4 sigma_k + mu_k`` for the argmax active mode k;
+discrete: argmax slot -> integer code.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fed_tgan_tpu.features.transformer import (
+    SCALE,
+    ContinuousColumn,
+    DiscreteColumn,
+)
+
+
+def make_device_decode(columns: Sequence) -> Callable[[jax.Array], jax.Array]:
+    """Build a jit-friendly decoder from ``ModeNormalizer.columns``.
+
+    The per-column walk happens at trace time (static layout); the returned
+    function is pure gathers/argmaxes.
+    """
+    plan = []
+    st = 0
+    for col in columns:
+        if isinstance(col, ContinuousColumn):
+            gmm = col.gmm
+            active = np.flatnonzero(gmm.active)
+            plan.append(
+                (
+                    "cont",
+                    st,
+                    len(active),
+                    np.asarray(gmm.means[active], dtype=np.float32),
+                    np.asarray(gmm.stds[active], dtype=np.float32),
+                )
+            )
+            st += 1 + len(active)
+        else:
+            assert isinstance(col, DiscreteColumn)
+            plan.append(("disc", st, col.size, np.asarray(col.codes, dtype=np.int32), None))
+            st += col.size
+    total_dim = st
+
+    def decode(encoded: jax.Array) -> jax.Array:
+        assert encoded.shape[-1] == total_dim, (encoded.shape, total_dim)
+        outs = []
+        for kind, start, size, a, b in plan:
+            if kind == "cont":
+                u = jnp.clip(encoded[:, start], -1.0, 1.0)
+                v = encoded[:, start + 1 : start + 1 + size]
+                k = jnp.argmax(v, axis=1)
+                outs.append(u * SCALE * jnp.asarray(b)[k] + jnp.asarray(a)[k])
+            else:
+                v = encoded[:, start : start + size]
+                codes = jnp.asarray(a)[jnp.argmax(v, axis=1)]
+                outs.append(codes.astype(jnp.float32))
+        return jnp.stack(outs, axis=1)
+
+    return decode

@@ -29,12 +29,14 @@ import numpy as np
 GUMBEL_TAU = 0.2  # reference ctgan.py:77
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=False)
 class SegmentSpec:
     """Static index arrays describing one table's encoded layout.
 
     All members are host numpy; they become XLA constants when closed over by
-    a jitted function.
+    a jitted function.  Used as pytree *metadata* by the sampler pytrees, so
+    equality/hash must be cheap and total: every derived array is a pure
+    function of ``output_info``, which therefore serves as the identity.
     """
 
     output_info: tuple  # ((size, kind), ...) — the reference's output_info
@@ -49,6 +51,12 @@ class SegmentSpec:
     cond_column_ids: np.ndarray  # (n_opt,) conditional-column index per cond position
     cond_offsets: np.ndarray  # (n_discrete,) start of each cond column in cond layout
     cond_sizes: np.ndarray  # (n_discrete,) width of each cond column
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SegmentSpec) and self.output_info == other.output_info
+
+    def __hash__(self) -> int:
+        return hash(self.output_info)
 
     @classmethod
     def from_output_info(cls, output_info) -> "SegmentSpec":

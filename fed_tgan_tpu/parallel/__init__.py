@@ -1,0 +1,4 @@
+from fed_tgan_tpu.parallel.fedavg import weighted_average
+from fed_tgan_tpu.parallel.mesh import client_mesh
+
+__all__ = ["client_mesh", "weighted_average"]

@@ -1,0 +1,38 @@
+"""In-graph weighted federated averaging.
+
+The reference's aggregation is a Python loop over state_dict layers on the
+server after shipping every client's weights over RPC (reference
+Server/dtds/distributed.py:86-132, :799-823) — the dominant per-epoch
+communication cost.  Here it is one ``lax.psum`` of weight-scaled parameter
+pytrees over the ``clients`` mesh axis: the result lands replicated on every
+device, so the reference's separate "distribute averaged weights back"
+round-trip (distributed.py:821-823) costs nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS
+
+
+def weighted_average(tree, weights: jax.Array, axis_name: str = CLIENTS_AXIS):
+    """sum_i w_i * leaf_i over the mesh axis, for every leaf.
+
+    Call inside shard_map.  ``tree`` leaves carry a leading local-clients
+    axis of size k (>=1); ``weights`` is the local (k,) slice of the global
+    weight vector.  Returns leaves WITHOUT the leading axis: the global
+    weighted sum, identical on every device (psum replicates it).
+    """
+
+    def avg(leaf):
+        local = jnp.tensordot(weights, leaf.astype(jnp.float32), axes=1)
+        return jax.lax.psum(local, axis_name).astype(leaf.dtype)
+
+    return jax.tree.map(avg, tree)
+
+
+def replicate_local(tree, k: int):
+    """Broadcast averaged leaves back to the per-local-client layout."""
+    return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (k,) + x.shape), tree)

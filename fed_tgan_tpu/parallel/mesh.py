@@ -1,0 +1,48 @@
+"""Device-mesh construction for the ``clients`` axis.
+
+The reference's world is rank 0 (server) + N client processes over TCP
+(reference Server/dtds/distributed.py:838-891).  Here the world is a 1-D
+``jax.sharding.Mesh`` with a ``clients`` axis: each mesh position simulates
+one (or, when n_clients > n_devices, several) federated participants, and
+there is no separate server rank — aggregation is a collective.
+
+Multi-host: initialize ``jax.distributed`` before building the mesh and the
+same code spans hosts, with XLA routing the FedAvg psum over ICI within a
+slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+CLIENTS_AXIS = "clients"
+
+
+def client_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over ``n_devices`` (default: all) with axis 'clients'."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (CLIENTS_AXIS,))
+
+
+def clients_per_device(n_clients: int, mesh: Mesh) -> int:
+    """How many simulated participants each device hosts.
+
+    n_clients must be a multiple of the mesh size so every device runs the
+    same program shape (SPMD)."""
+    n_dev = mesh.devices.size
+    if n_clients % n_dev != 0:
+        raise ValueError(
+            f"n_clients={n_clients} must be a multiple of mesh size {n_dev}; "
+            "pad the client list or shrink the mesh"
+        )
+    return n_clients // n_dev

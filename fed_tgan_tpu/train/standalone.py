@@ -19,10 +19,10 @@ from fed_tgan_tpu.ops.segments import SegmentSpec
 from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
 from fed_tgan_tpu.train.steps import (
     ModelBundle,
+    SampleProgramCache,
     TrainConfig,
     init_models,
     make_epoch_step,
-    make_sample_step,
 )
 
 
@@ -70,7 +70,7 @@ class StandaloneSynthesizer:
         self.models = init_models(init_key, self.spec, self.cfg)
 
         epoch_fn = jax.jit(make_epoch_step(self.spec, self.cfg, steps_per_epoch))
-        self._sample_fn = jax.jit(make_sample_step(self.spec, self.cfg))
+        self._encoded_cache = SampleProgramCache(self.spec, self.cfg)
         for i in range(epochs):
             t0 = time.time()
             key, ekey = jax.random.split(key)
@@ -88,22 +88,13 @@ class StandaloneSynthesizer:
     def sample_encoded(self, n: int, seed: int = 0) -> np.ndarray:
         """n rows in the encoded (transformed) layout."""
         assert self.models is not None, "fit first"
-        sample_fn = self._sample_fn
-        steps = -(-n // self.cfg.batch_size)  # ceil
-        key = jax.random.key(seed + 17)
-        out = []
-        for i in range(steps):
-            out.append(
-                np.asarray(
-                    sample_fn(
-                        self.models.params_g,
-                        self.models.state_g,
-                        self.cond,
-                        jax.random.fold_in(key, i),
-                    )
-                )
-            )
-        return np.concatenate(out, axis=0)[:n]
+        return self._encoded_cache.sample(
+            self.models.params_g,
+            self.models.state_g,
+            self.cond,
+            n,
+            jax.random.key(seed + 17),
+        )
 
     def sample(self, n: int, seed: int = 0) -> np.ndarray:
         """n decoded rows (numeric column values, categorical as codes)."""

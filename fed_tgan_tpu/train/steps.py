@@ -213,3 +213,66 @@ def make_sample_step(spec: SegmentSpec, cfg: TrainConfig):
         return apply_activate(raw, spec, ka)
 
     return sample
+
+
+def make_sample_many(spec: SegmentSpec, cfg: TrainConfig, n_steps: int, decode_fn=None):
+    """Generate n_steps * batch_size rows in one device program.
+
+    Per-batch host round-trips are expensive (especially over a tunneled
+    device); a lax.scan keeps the whole generation on device.  ``start``
+    offsets the key folding so chunked callers keep one global key schedule.
+    ``decode_fn`` (see ops.decode) fuses the inverse transform in-graph."""
+    single = make_sample_step(spec, cfg)
+
+    def sample_many(params_g, state_g, cond: CondSampler, key, start):
+        def body(carry, i):
+            return carry, single(params_g, state_g, cond, jax.random.fold_in(key, start + i))
+
+        _, out = jax.lax.scan(body, None, jnp.arange(n_steps))
+        out = out.reshape(n_steps * cfg.batch_size, -1)
+        return decode_fn(out) if decode_fn is not None else out
+
+    return sample_many
+
+
+class SampleProgramCache:
+    """Compile-bounded, memory-bounded generation.
+
+    Large requests run as host-chunked device programs of at most
+    ``max_chunk_steps`` batches (bounding the on-device result buffer); the
+    tail chunk is bucketed to the next power of two so the number of distinct
+    compiled programs is O(log max_chunk_steps), not O(#distinct sizes).
+    """
+
+    def __init__(self, spec: SegmentSpec, cfg: TrainConfig, decode_fn=None,
+                 max_chunk_steps: int = 64):
+        self.spec = spec
+        self.cfg = cfg
+        self.decode_fn = decode_fn
+        self.max_chunk_steps = max_chunk_steps
+        self._programs: dict[int, Any] = {}
+
+    def _program(self, n_steps: int):
+        if n_steps not in self._programs:
+            self._programs[n_steps] = jax.jit(
+                make_sample_many(self.spec, self.cfg, n_steps, self.decode_fn)
+            )
+        return self._programs[n_steps]
+
+    def sample(self, params_g, state_g, cond: CondSampler, n: int, key):
+        import numpy as np
+
+        total_steps = -(-n // self.cfg.batch_size)
+        out, start = [], 0
+        while start < total_steps:
+            remaining = total_steps - start
+            if remaining >= self.max_chunk_steps:
+                steps = self.max_chunk_steps
+            else:
+                steps = 1 << (remaining - 1).bit_length()  # next power of two
+                steps = min(steps, self.max_chunk_steps)
+            out.append(
+                np.asarray(self._program(steps)(params_g, state_g, cond, key, start))
+            )
+            start += steps
+        return np.concatenate(out, axis=0)[:n]

@@ -1,0 +1,107 @@
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fed_tgan_tpu.eval.similarity import similarity_report, statistical_similarity
+from fed_tgan_tpu.eval.utility import utility_difference
+
+
+def test_statistical_similarity_identical_is_zero(toy_frame):
+    avg_jsd, avg_wd, per = statistical_similarity(
+        toy_frame, toy_frame, ["color", "flag"]
+    )
+    assert avg_jsd == pytest.approx(0.0, abs=1e-9)
+    assert avg_wd == pytest.approx(0.0, abs=1e-9)
+    assert set(per) == set(toy_frame.columns)
+
+
+def test_statistical_similarity_detects_shift(toy_frame):
+    fake = toy_frame.copy()
+    fake["score"] = fake["score"] + 3.0
+    fake["color"] = "red"
+    avg_jsd, avg_wd, _ = statistical_similarity(toy_frame, fake, ["color", "flag"])
+    assert avg_jsd > 0.1
+    assert avg_wd > 0.05
+
+
+def test_similarity_report_csv_layout(tmp_path, toy_frame):
+    real_p = tmp_path / "real.csv"
+    toy_frame.to_csv(real_p, index=False)
+    fakes = []
+    for i in range(2):
+        fp = tmp_path / f"fake_{i}.csv"
+        toy_frame.sample(frac=1.0, random_state=i).to_csv(fp, index=False)
+        fakes.append(str(fp))
+    df = similarity_report(str(real_p), fakes, ["color", "flag"], epoch_times=[1.5, 2.0])
+    assert df.columns.tolist() == ["Epoch_No.", "Avg_JSD", "Avg_WD", "time_stamp"]
+    assert df["time_stamp"].tolist() == [1.5, 3.5]
+
+
+def test_utility_difference(toy_frame):
+    train = toy_frame.iloc[:400]
+    test = toy_frame.iloc[400:]
+    # synthetic == real train -> difference ~ 0
+    res = utility_difference(train, train, test, "flag", ["color", "flag"])
+    assert abs(res["delta_f1"]) < 1e-9
+    assert len(res["real"]) == 4  # LR, DT, RF, MLP
+
+
+@pytest.mark.slow
+def test_cli_end_to_end(tmp_path, toy_frame):
+    data_p = tmp_path / "toy.csv"
+    toy_frame.to_csv(data_p, index=False)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "fed_tgan_tpu.cli",
+            "--datapath", str(data_p),
+            "--dataset", "custom",
+            "--categorical", "color", "flag",
+            "--non-negative", "amount",
+            "--target-column", "flag",
+            "--n-clients", "4",
+            "--epochs", "2",
+            "--batch-size", "50",
+            "--embedding-dim", "16",
+            "--sample-rows", "200",
+            "--backend", "cpu",
+            "--n-virtual-devices", "4",
+            "--out-dir", str(tmp_path),
+            "--eval",
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd="/root/repo",
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = proc.stdout
+    assert "final Avg_JSD=" in out
+    result = tmp_path / "toy_result"
+    assert (result / "toy_synthesis_epoch_0.csv").exists()
+    assert (result / "toy_synthesis_epoch_1.csv").exists()
+    assert (tmp_path / "timestamp_experiment.csv").exists()
+    assert (tmp_path / "models" / "toy.json").exists()
+    snap = pd.read_csv(result / "toy_synthesis_epoch_1.csv")
+    assert snap.shape == (200, 4)
+    assert set(snap.columns) == set(toy_frame.columns)
+    # decoded categories are raw strings again
+    assert set(snap["color"].unique()) <= {"red", "green", "blue"}
+
+
+def test_cli_nonzero_rank_exits_cleanly():
+    proc = subprocess.run(
+        [sys.executable, "-m", "fed_tgan_tpu.cli", "-rank", "1"],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "SPMD" in proc.stdout

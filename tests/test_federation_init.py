@@ -1,0 +1,100 @@
+import numpy as np
+import pytest
+from scipy.spatial import distance as sdistance
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.features.bgm import ColumnGMM
+from fed_tgan_tpu.federation.init import (
+    aggregation_weights,
+    federated_initialize,
+    harmonize_categories,
+    harmonize_continuous,
+)
+
+
+def _meta(freqs: dict) -> dict:
+    cols = []
+    for name, spec in freqs.items():
+        if isinstance(spec, dict):
+            cols.append({"column_name": name, "type": "categorical", "size": len(spec), "i2s": spec})
+        else:
+            cols.append({"column_name": name, "type": "continous", "min": spec[0], "max": spec[1]})
+    return {"columns": cols, "date_info": {}, "integer_info": [], "non_negative_cols": [], "problem_type": "", "name": "t"}
+
+
+def test_harmonize_categories_golden():
+    metas = [
+        _meta({"c": {"x": 3, "y": 1}}),
+        _meta({"c": {"y": 4}}),
+    ]
+    gmeta, encoders, jsd = harmonize_categories(metas)
+    # global order by merged frequency: y(5) > x(3)
+    assert gmeta["columns"][0]["i2s"] == ["y", "x"]
+    assert len(encoders) == 1 and encoders[0].classes_.tolist() == ["x", "y"]
+
+    # golden JSD values (vec indexed by encoder code: x->0, y->1)
+    d_a = sdistance.jensenshannon([3, 5], [3, 1])
+    d_b = sdistance.jensenshannon([3, 5], [0, 4])
+    want = np.array([[d_a], [d_b]]) / (d_a + d_b)
+    assert np.allclose(jsd, want)
+
+
+def test_harmonize_categories_single_client_zero_fallback():
+    metas = [_meta({"c": {"x": 3, "y": 1}})]
+    _, _, jsd = harmonize_categories(metas)
+    # JSD(global, only-client) == 0 -> fallback 1/n_clients
+    assert jsd.tolist() == [[1.0]]
+
+
+def test_harmonize_continuous_golden():
+    g_narrow = ColumnGMM(
+        means=np.array([0.0]), stds=np.array([1.0]), weights=np.array([1.0]), active=np.array([True])
+    )
+    g_shift = ColumnGMM(
+        means=np.array([5.0]), stds=np.array([1.0]), weights=np.array([1.0]), active=np.array([True])
+    )
+    client_gmms = [[g_narrow, None], [g_shift, None]]
+    global_gmms, wd = harmonize_continuous(client_gmms, [1000, 1000], seed=0)
+    assert global_gmms[1] is None
+    gg = global_gmms[0]
+    # pooled fit must place active mass near both 0 and 5
+    act = np.sort(gg.means[gg.active])
+    assert act.min() < 1.5 and act.max() > 3.5
+    # both clients equally far from the pooled mixture
+    assert wd.shape == (2, 1)
+    assert np.allclose(wd.sum(axis=0), 1.0)
+    assert abs(wd[0, 0] - 0.5) < 0.1
+
+
+def test_aggregation_weights_golden():
+    jsd = np.array([[0.8], [0.2]])
+    wd = np.array([[0.6], [0.4]])
+    rows = [100, 300]
+    w = aggregation_weights(jsd, wd, rows)
+    combo = np.array([1.4, 0.6])
+    raw = (1 - combo / 2.0) * np.array([0.25, 0.75])
+    want = np.exp(raw) / np.exp(raw).sum()
+    assert np.allclose(w, want)
+    assert w.sum() == pytest.approx(1.0)
+    # the more-similar, larger client dominates
+    assert w[1] > w[0]
+
+
+def test_federated_initialize_end_to_end(toy_frame, toy_spec):
+    shards = shard_dataframe(toy_frame, 3, "dirichlet", label_column="flag", alpha=0.5, seed=2)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    init = federated_initialize(clients, seed=0)
+
+    assert len(init.client_matrices) == 3
+    dims = {m.shape[1] for m in init.client_matrices}
+    assert len(dims) == 1, "all clients must agree on encoded width"
+    assert all(
+        t.output_info == init.transformers[0].output_info for t in init.transformers
+    )
+    assert init.weights.shape == (3,)
+    assert init.weights.sum() == pytest.approx(1.0)
+    assert init.global_meta.categorical_columns == ["color", "flag"]
+
+    uninit = federated_initialize(clients, seed=0, weighted=False)
+    assert np.allclose(uninit.weights, 1 / 3)
