@@ -147,13 +147,17 @@ def main(argv=None) -> int:
         )
 
     selected = kwargs.pop("selected_columns", None)
+    # every participant must present the same schema — harmonization merges
+    # metas positionally, so a missing column would silently cross wires
+    for i, f in enumerate(frames):
+        want = list(selected) if selected else list(frames[0].columns)
+        missing = [c for c in want if c not in f.columns]
+        if missing:
+            print(f"client {i}: input is missing columns {missing}")
+            return 2
+    columns = list(selected) if selected else list(frames[0].columns)
     clients = [
-        TablePreprocessor(
-            frame=f,
-            name=name,
-            selected_columns=[c for c in (selected or f.columns) if c in f.columns],
-            **kwargs,
-        )
+        TablePreprocessor(frame=f, name=name, selected_columns=columns, **kwargs)
         for f in frames
     ]
 
@@ -206,11 +210,15 @@ def main(argv=None) -> int:
     if args.eval:
         from fed_tgan_tpu.eval.similarity import statistical_similarity
 
-        full = pd.concat(frames)
-        last_epoch = args.epochs - 1 if args.sample_every else args.epochs - 1
+        if args.sample_every:
+            last_epoch = ((args.epochs - 1) // args.sample_every) * args.sample_every
+        else:
+            last_epoch = args.epochs - 1
         fake = pd.read_csv(
             os.path.join(result_dir, f"{name}_synthesis_epoch_{last_epoch}.csv")
         )
+        # compare on the columns actually synthesized (the selected schema)
+        full = pd.concat(frames)[fake.columns.tolist()]
         avg_jsd, avg_wd, _ = statistical_similarity(
             full, fake, kwargs["categorical_columns"]
         )

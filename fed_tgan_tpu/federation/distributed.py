@@ -1,0 +1,107 @@
+"""Multi-host federated init over the native transport.
+
+Runs the same protocol as ``federated_initialize`` but with real process/host
+separation, mirroring the reference's RPC choreography (reference
+Server/dtds/distributed.py:866-874):
+
+  server                          clients (rank 1..N)
+  ------                          -------------------
+  gather local metas         <--  send local_meta()
+  harmonize categories
+  broadcast meta+encoders    -->  encode data, fit local GMMs
+  gather (gmms, n_rows)      <--  send transformer information
+  harmonize continuous
+  broadcast global GMMs      -->  refit transformer, transform data
+  compute weights
+  broadcast weights          -->  ready to join the device mesh
+
+After init, every client holds its encoded shard + transformer + the global
+aggregation weights; training then happens on the JAX mesh (each host runs
+its mesh slice; across hosts XLA collectives ride ICI/DCN via
+``jax.distributed``), NOT over this transport.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.schema import TableMeta
+from fed_tgan_tpu.features.transformer import ModeNormalizer
+from fed_tgan_tpu.federation.init import (
+    aggregation_weights,
+    harmonize_categories,
+    harmonize_continuous,
+)
+from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
+
+
+def server_initialize(
+    transport: ServerTransport,
+    seed: int = 0,
+    weighted: bool = True,
+    backend: str = "sklearn",
+) -> dict:
+    """Drive the init protocol from rank 0; returns the global artifacts."""
+    local_metas = transport.gather()
+
+    global_meta_dict, encoders, jsd = harmonize_categories(local_metas)
+    transport.broadcast({"meta": global_meta_dict, "encoders": encoders})
+
+    infos = transport.gather()  # [{"gmms": [...], "rows": int}]
+    client_gmms = [i["gmms"] for i in infos]
+    rows = [i["rows"] for i in infos]
+
+    global_gmms, wd = harmonize_continuous(client_gmms, rows, seed=seed, backend=backend)
+    transport.broadcast({"gmms": global_gmms})
+
+    if weighted:
+        weights = aggregation_weights(jsd, wd, rows)
+    else:
+        weights = np.full(len(rows), 1.0 / len(rows))
+    transport.broadcast({"weights": weights})
+
+    return {
+        "global_meta": TableMeta.from_json_dict(global_meta_dict),
+        "encoders": encoders,
+        "global_gmms": global_gmms,
+        "weights": weights,
+        "jsd": jsd,
+        "wd": wd,
+        "rows_per_client": rows,
+    }
+
+
+def client_initialize(
+    transport: ClientTransport,
+    preprocessor: TablePreprocessor,
+    seed: int = 0,
+    backend: str = "sklearn",
+) -> dict:
+    """Participate in the init protocol; returns this shard's artifacts."""
+    transport.send_obj(preprocessor.local_meta())
+
+    msg = transport.recv_obj()
+    global_meta = TableMeta.from_json_dict(msg["meta"])
+    encoders = msg["encoders"]
+
+    matrix, cat_idx, _ = preprocessor.encode(encoders)
+    local_tf = ModeNormalizer(backend=backend, seed=seed).fit(matrix, cat_idx)
+    transport.send_obj({"gmms": local_tf.column_gmms, "rows": len(matrix)})
+
+    global_gmms = transport.recv_obj()["gmms"]
+    transformer = ModeNormalizer(backend=backend, seed=seed).refit_with_global(
+        global_meta, encoders, global_gmms
+    )
+    encoded = transformer.transform(
+        matrix, rng=np.random.default_rng(seed + transport.rank)
+    )
+    weights = transport.recv_obj()["weights"]
+
+    return {
+        "global_meta": global_meta,
+        "encoders": encoders,
+        "transformer": transformer,
+        "matrix": encoded,
+        "weights": weights,
+    }

@@ -1,0 +1,3 @@
+from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
+
+__all__ = ["ClientTransport", "ServerTransport"]

@@ -1,0 +1,165 @@
+"""Python bindings for the native host transport (native/transport.cpp).
+
+Replaces the role of PyTorch RPC over Gloo/TensorPipe in the reference
+(reference Server/dtds/distributed.py:849-857): a TCP rendezvous of one
+server and N clients carrying pickled control-plane objects (metadata,
+encoders, mixture models).  The hot path — per-epoch model aggregation —
+never touches this: it is an XLA collective on the device mesh.
+
+The shared library is built on demand with g++ (ctypes, no pybind11
+dependency) and cached next to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from typing import Any, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libfttransport.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_NATIVE_DIR, "transport.cpp")
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
+                 "-o", _LIB_PATH, src],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ft_server_create.restype = ctypes.c_void_p
+        lib.ft_server_create.argtypes = [ctypes.c_int]
+        lib.ft_server_accept.restype = ctypes.c_int
+        lib.ft_server_accept.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.ft_client_create.restype = ctypes.c_void_p
+        lib.ft_client_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.ft_send.restype = ctypes.c_int
+        lib.ft_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.ft_recv.restype = ctypes.c_int
+        lib.ft_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ]
+        lib.ft_free.restype = None
+        lib.ft_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.ft_close.restype = None
+        lib.ft_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+_ERRORS = {-1: "socket error", -2: "timeout", -3: "peer closed", -4: "bad argument"}
+
+
+def _check(rc: int, what: str) -> None:
+    if rc < 0:
+        raise TransportError(f"{what}: {_ERRORS.get(rc, rc)}")
+
+
+class _Endpoint:
+    def __init__(self, handle: int):
+        self._lib = _load_library()
+        self._handle = handle
+
+    def _send_bytes(self, peer: int, payload: bytes, timeout_ms: int) -> None:
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        _check(
+            self._lib.ft_send(self._handle, peer, buf, len(payload), timeout_ms),
+            "send",
+        )
+
+    def _recv_bytes(self, peer: int, timeout_ms: int) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        _check(
+            self._lib.ft_recv(
+                self._handle, peer, ctypes.byref(out), ctypes.byref(out_len), timeout_ms
+            ),
+            "recv",
+        )
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.ft_free(out)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ft_close(self._handle)
+            self._handle = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ServerTransport(_Endpoint):
+    """Rank-0 endpoint: accepts n clients, then object send/recv per rank."""
+
+    def __init__(self, port: int, n_clients: int, timeout_ms: int = 600_000):
+        lib = _load_library()
+        handle = lib.ft_server_create(port)
+        if not handle:
+            raise TransportError(f"cannot listen on port {port}")
+        super().__init__(handle)
+        self.n_clients = n_clients
+        rc = lib.ft_server_accept(handle, n_clients, timeout_ms)
+        if rc < 0:
+            self.close()
+            _check(rc, "accept")
+
+    def send_obj(self, rank: int, obj: Any, timeout_ms: int = 600_000) -> None:
+        self._send_bytes(rank, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL), timeout_ms)
+
+    def recv_obj(self, rank: int, timeout_ms: int = 600_000) -> Any:
+        return pickle.loads(self._recv_bytes(rank, timeout_ms))
+
+    def broadcast(self, obj: Any, timeout_ms: int = 600_000) -> None:
+        for rank in range(1, self.n_clients + 1):
+            self.send_obj(rank, obj, timeout_ms)
+
+    def gather(self, timeout_ms: int = 600_000) -> list:
+        return [self.recv_obj(rank, timeout_ms) for rank in range(1, self.n_clients + 1)]
+
+
+class ClientTransport(_Endpoint):
+    """Rank >= 1 endpoint; retries the rendezvous until the server is up."""
+
+    def __init__(self, host: str, port: int, rank: int, timeout_ms: int = 600_000):
+        lib = _load_library()
+        handle = lib.ft_client_create(host.encode(), port, rank, timeout_ms)
+        if not handle:
+            raise TransportError(f"cannot reach server at {host}:{port}")
+        super().__init__(handle)
+        self.rank = rank
+
+    def send_obj(self, obj: Any, timeout_ms: int = 600_000) -> None:
+        self._send_bytes(0, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL), timeout_ms)
+
+    def recv_obj(self, timeout_ms: int = 600_000) -> Any:
+        return pickle.loads(self._recv_bytes(0, timeout_ms))

@@ -84,15 +84,30 @@ def make_federated_epoch(
 
         def run_one(models_i, data_i, cond_i, rows_i, steps_ii, local_idx):
             key_i = jax.random.fold_in(key, rank * k + local_idx)
+            # mark the zero init as device-varying so the scan carry type
+            # matches the per-client metrics produced inside the loop
+            zero_metrics = {
+                name: jax.lax.pcast(
+                    jnp.zeros((), jnp.float32), (CLIENTS_AXIS,), to="varying"
+                )
+                for name in ("loss_d", "pen", "loss_g")
+            }
 
             def body(carry, s):
-                new, metrics = step(carry, data_i, cond_i, rows_i, jax.random.fold_in(key_i, s))
+                models_c, last_metrics = carry
+                new, metrics = step(models_c, data_i, cond_i, rows_i, jax.random.fold_in(key_i, s))
+                # mask past this client's true step count: params AND the
+                # reported metrics stay at their last real values
                 valid = s < steps_ii
-                merged = jax.tree.map(lambda a, b: jnp.where(valid, a, b), new, carry)
-                return merged, metrics
+                sel = lambda a, b: jax.tree.map(
+                    lambda x, y: jnp.where(valid, x, y), a, b
+                )
+                return (sel(new, models_c), sel(metrics, last_metrics)), None
 
-            models_i, metrics = jax.lax.scan(body, models_i, jnp.arange(max_steps))
-            return models_i, jax.tree.map(lambda m: m[-1], metrics)
+            (models_i, metrics), _ = jax.lax.scan(
+                body, (models_i, zero_metrics), jnp.arange(max_steps)
+            )
+            return models_i, metrics
 
         models, metrics = jax.vmap(run_one)(
             models, data, cond, rows, steps_i, jnp.arange(k)
@@ -220,8 +235,9 @@ class FederatedTrainer:
             models, metrics = self._epoch_fn(
                 models, data, cond, rows, steps, weights, ekey
             )
-            if sample_hook is not None or log_every:
-                jax.block_until_ready(models)
+            # epoch_times feeds timestamp_experiment.csv — must measure the
+            # round's real wall-clock, not async dispatch latency
+            jax.block_until_ready(models)
             self.models = models
             self.epoch_times.append(time.time() - t0)
             if log_every and (e % log_every == 0):
