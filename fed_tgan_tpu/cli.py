@@ -57,6 +57,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="epochs between synthetic snapshots; 0 = only at end")
     p.add_argument("--out-dir", type=str, default=".")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--save-every", type=int, default=0,
+                   help="rounds between full-resume checkpoints; 0 = none")
+    p.add_argument("--ckpt-dir", type=str, default=None,
+                   help="checkpoint directory (default <out>/checkpoint)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from --ckpt-dir; --epochs counts total rounds")
+    p.add_argument("--save-model", action="store_true",
+                   help="persist the sampling artifact to <out>/models/synthesizer")
     p.add_argument("--eval", action="store_true",
                    help="run similarity analysis against the training data at the end")
     p.add_argument("--quiet", action="store_true")
@@ -130,6 +138,30 @@ def main(argv=None) -> int:
     if n_clients is None:
         n_clients = (args.world_size - 1) if args.world_size else len(jax.devices())
 
+    ckpt_dir = args.ckpt_dir or os.path.join(args.out_dir, "checkpoint")
+    if args.resume:
+        from fed_tgan_tpu.runtime.checkpoint import load_federated
+
+        trainer = load_federated(ckpt_dir)
+        init = trainer.init
+        # the checkpointed run identity wins over re-derived CLI defaults so
+        # output paths stay stable even when flags aren't re-passed
+        name = trainer.run_name or name
+        kwargs["categorical_columns"] = init.global_meta.categorical_columns
+        frames = None
+        if args.eval:
+            try:
+                if args.client_data:
+                    frames = [pd.read_csv(p) for p in args.client_data]
+                else:
+                    frames = [pd.read_csv(args.datapath)]
+            except OSError as exc:
+                print(f"--eval skipped: cannot reload training data ({exc}); "
+                      "pass --datapath/--client-data to evaluate a resumed run")
+        if not args.quiet:
+            print(f"resumed from {ckpt_dir} at round {trainer.completed_epochs}")
+        return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
+
     t_init = time.time()
     if args.client_data:
         frames = [pd.read_csv(p) for p in args.client_data]
@@ -171,6 +203,13 @@ def main(argv=None) -> int:
 
     cfg = TrainConfig(batch_size=args.batch_size, embedding_dim=args.embedding_dim)
     trainer = FederatedTrainer(init, config=cfg, seed=args.seed)
+    return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
+
+
+def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
+    import pandas as pd
+
+    from fed_tgan_tpu.data.decode import decode_matrix
 
     result_dir = os.path.join(args.out_dir, f"{name}_result")
     models_dir = os.path.join(args.out_dir, "models")
@@ -187,7 +226,7 @@ def main(argv=None) -> int:
             f,
         )
 
-    def snapshot(epoch: int, tr: FederatedTrainer) -> None:
+    def snapshot(epoch: int, tr) -> None:
         decoded = tr.sample(args.sample_rows, seed=args.seed + epoch)
         raw = decode_matrix(decoded, init.global_meta, init.encoders)
         raw.to_csv(
@@ -195,27 +234,44 @@ def main(argv=None) -> int:
             index=False,
         )
 
-    hook = None
-    if args.sample_every:
-        hook = lambda e, tr: snapshot(e, tr) if e % args.sample_every == 0 else None
+    def hook(e, tr):
+        if args.sample_every and e % args.sample_every == 0:
+            snapshot(e, tr)
+        if args.save_every and (e + 1) % args.save_every == 0:
+            from fed_tgan_tpu.runtime.checkpoint import save_federated
 
-    trainer.fit(args.epochs, log_every=0 if args.quiet else max(1, args.epochs // 10),
-                sample_hook=hook)
-    if args.sample_every == 0:
-        snapshot(args.epochs - 1, trainer)
+            save_federated(tr, ckpt_dir, run_name=name)
+
+    # --epochs is the TOTAL round budget; a resumed run does the remainder
+    remaining = max(0, args.epochs - trainer.completed_epochs)
+    trainer.fit(remaining, log_every=0 if args.quiet else max(1, remaining // 10),
+                sample_hook=hook if (args.sample_every or args.save_every) else None)
+    last_epoch = trainer.completed_epochs - 1
+    if args.sample_every == 0 and last_epoch >= 0:
+        snapshot(last_epoch, trainer)
+
+    # final checkpoint, unless the in-hook save already wrote this round
+    if args.save_every and trainer.completed_epochs % args.save_every != 0:
+        from fed_tgan_tpu.runtime.checkpoint import save_federated
+
+        save_federated(trainer, ckpt_dir, run_name=name)
+    if args.save_model:
+        from fed_tgan_tpu.runtime.checkpoint import save_synthesizer
+
+        save_synthesizer(trainer, os.path.join(models_dir, "synthesizer"))
 
     with open(os.path.join(args.out_dir, "timestamp_experiment.csv"), "w") as f:
         csv.writer(f).writerows([[t] for t in trainer.epoch_times])
 
-    if args.eval:
+    if args.eval and frames is not None:
         from fed_tgan_tpu.eval.similarity import statistical_similarity
 
         if args.sample_every:
-            last_epoch = ((args.epochs - 1) // args.sample_every) * args.sample_every
+            last_snap = (last_epoch // args.sample_every) * args.sample_every
         else:
-            last_epoch = args.epochs - 1
+            last_snap = last_epoch
         fake = pd.read_csv(
-            os.path.join(result_dir, f"{name}_synthesis_epoch_{last_epoch}.csv")
+            os.path.join(result_dir, f"{name}_synthesis_epoch_{last_snap}.csv")
         )
         # compare on the columns actually synthesized (the selected schema)
         full = pd.concat(frames)[fake.columns.tolist()]
@@ -226,8 +282,9 @@ def main(argv=None) -> int:
 
     if not args.quiet:
         total = sum(trainer.epoch_times)
-        print(f"{args.epochs} rounds in {total:.1f}s "
-              f"({total / max(args.epochs, 1):.3f}s/round)")
+        n = max(len(trainer.epoch_times), 1)
+        print(f"{len(trainer.epoch_times)} rounds in {total:.1f}s "
+              f"({total / n:.3f}s/round)")
     return 0
 
 

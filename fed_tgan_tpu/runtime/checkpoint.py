@@ -1,0 +1,196 @@
+"""Checkpoint / resume.
+
+The reference's only persistence is ``MDGANServer.save_model`` — a
+``torch.save`` of ``[generator, cond_generator, transformer, batch_size,
+embedding_dim]`` that is never called from the training loop, and there is
+no resume path at all (reference Server/dtds/distributed.py:560-563; SURVEY
+§5.4).  Here both halves exist:
+
+- ``save_synthesizer`` / ``load_synthesizer`` — the reference-parity
+  sampling artifact: generator params + conditional sampler + transformer +
+  config, enough to ``sample()`` without the training data.
+- ``save_federated`` / ``load_federated`` — full training-state checkpoints
+  for the SPMD trainer: every client's model/optimizer pytree, the RNG key
+  schedule, the round counter, and the federated-init artifacts (global
+  meta, encoders, GMMs, aggregation weights), so a restored run continues
+  bit-for-bit where it stopped.
+
+Format: a directory holding ``host.pkl`` (plain-Python/numpy objects) and
+``arrays.npz`` (every pytree leaf, keyed by flatten order).  Leaves are
+restored into a freshly-constructed trainer whose pytree *structure* is
+rebuilt from the checkpointed config, so no treedef serialization is needed.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+_HOST = "host.pkl"
+_ARRAYS = "arrays.npz"
+
+
+def _save_leaves(tree, extra: dict, path: str) -> None:
+    leaves = jax.tree.leaves(tree)
+    arrays = {f"leaf_{i:05d}": np.asarray(l) for i, l in enumerate(leaves)}
+    arrays.update({k: np.asarray(v) for k, v in extra.items()})
+    np.savez(os.path.join(path, _ARRAYS), **arrays)
+
+
+def _load_leaves(template, data) -> tuple:
+    n = len(jax.tree.leaves(template))
+    leaves = [data[f"leaf_{i:05d}"] for i in range(n)]
+    return jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+
+# --------------------------------------------------------------- federated
+
+
+def save_federated(trainer, path: str, run_name: str | None = None) -> None:
+    """Write a full-resume checkpoint of a ``FederatedTrainer`` to ``path``.
+
+    ``run_name`` (the dataset/output identity, e.g. "Intrusion") rides along
+    so a resumed run keeps writing to the same output layout without the
+    original CLI flags."""
+    os.makedirs(path, exist_ok=True)
+    host = {
+        "version": FORMAT_VERSION,
+        "kind": "federated",
+        "init": trainer.init,
+        "cfg": trainer.cfg,
+        "seed": trainer.seed,
+        "completed_epochs": trainer.completed_epochs,
+        "epoch_times": list(trainer.epoch_times),
+        "run_name": run_name,
+    }
+    with open(os.path.join(path, _HOST), "wb") as f:
+        pickle.dump(host, f)
+    _save_leaves(
+        trainer.models,
+        {"rng_key": jax.random.key_data(trainer._key)},
+        path,
+    )
+
+
+def load_federated(path: str, mesh=None):
+    """Reconstruct a ``FederatedTrainer`` from ``save_federated`` output.
+
+    The trainer is rebuilt from the checkpointed ``FederatedInit`` (so all
+    sampler tables, shardings and compiled programs are regenerated), then
+    its evolving state — models, optimizer moments, RNG key, round counter —
+    is overwritten from the checkpoint.
+    """
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+
+    with open(os.path.join(path, _HOST), "rb") as f:
+        host = pickle.load(f)
+    if host.get("kind") != "federated":
+        raise ValueError(f"{path} is not a federated checkpoint")
+    if host["version"] > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint version {host['version']} is newer than supported "
+            f"{FORMAT_VERSION}"
+        )
+
+    trainer = FederatedTrainer(
+        host["init"], config=host["cfg"], mesh=mesh, seed=host["seed"]
+    )
+    with np.load(os.path.join(path, _ARRAYS)) as data:
+        trainer.models = _load_leaves(trainer.models, data)
+        trainer._key = jax.random.wrap_key_data(data["rng_key"])
+    trainer.completed_epochs = host["completed_epochs"]
+    trainer.epoch_times = list(host["epoch_times"])
+    trainer.run_name = host.get("run_name")
+    return trainer
+
+
+# ------------------------------------------------------------- synthesizer
+
+
+class SavedSynthesizer:
+    """A sampling-only artifact (the reference ``save_model`` payload)."""
+
+    def __init__(self, params_g, state_g, cond, transformer, cfg, spec,
+                 key_offset: int = 17):
+        from fed_tgan_tpu.train.steps import SampleProgramCache
+
+        self.params_g = params_g
+        self.state_g = state_g
+        self.cond = cond
+        self.transformer = transformer
+        self.cfg = cfg
+        self.spec = spec
+        # the source object's sampling-key offset, so a loaded artifact
+        # reproduces the exact draws its source would have made
+        self.key_offset = key_offset
+        self._cache = SampleProgramCache(spec, cfg)
+
+    def sample_encoded(self, n: int, seed: int = 0) -> np.ndarray:
+        return self._cache.sample(
+            self.params_g, self.state_g, self.cond, n,
+            jax.random.key(seed + self.key_offset),
+        )
+
+    def sample(self, n: int, seed: int = 0) -> np.ndarray:
+        return self.transformer.inverse_transform(self.sample_encoded(n, seed))
+
+
+def save_synthesizer(synth, path: str) -> None:
+    """Persist the sampling artifact of a trained synthesizer/trainer.
+
+    Accepts a ``StandaloneSynthesizer`` or a ``FederatedTrainer`` (which
+    contributes its post-aggregation global generator and the pooled
+    conditional sampler, like the reference server's snapshot model).
+    """
+    os.makedirs(path, exist_ok=True)
+    if hasattr(synth, "_global_model"):  # FederatedTrainer
+        params_g, state_g = synth._global_model()
+        cond = synth.server_cond
+        transformer = synth.init.transformers[0]
+        key_offset = 29  # FederatedTrainer.sample_encoded's offset
+    else:
+        params_g, state_g = synth.models.params_g, synth.models.state_g
+        cond = synth.cond
+        transformer = synth.transformer
+        key_offset = 17  # StandaloneSynthesizer.sample_encoded's offset
+    host = {
+        "version": FORMAT_VERSION,
+        "kind": "synthesizer",
+        "cfg": synth.cfg,
+        "transformer": transformer,
+        "output_info": transformer.output_info,
+        "key_offset": key_offset,
+    }
+    with open(os.path.join(path, _HOST), "wb") as f:
+        pickle.dump(host, f)
+    _save_leaves((params_g, state_g, cond), {}, path)
+
+
+def load_synthesizer(path: str) -> SavedSynthesizer:
+    from fed_tgan_tpu.ops.segments import SegmentSpec
+    from fed_tgan_tpu.train.sampler import CondSampler
+    from fed_tgan_tpu.train.steps import TrainConfig, init_models
+
+    with open(os.path.join(path, _HOST), "rb") as f:
+        host = pickle.load(f)
+    if host.get("kind") != "synthesizer":
+        raise ValueError(f"{path} is not a synthesizer checkpoint")
+
+    cfg: TrainConfig = host["cfg"]
+    spec = SegmentSpec.from_output_info(host["output_info"])
+    # rebuild the pytree structure, then fill it with checkpointed leaves
+    template_models = init_models(jax.random.key(0), spec, cfg)
+    zeros = np.zeros((max(spec.n_discrete, 1), max(int(spec.cond_sizes.max()) if spec.n_discrete else 1, 1)))
+    template_cond = CondSampler(p_train=zeros, p_empirical=zeros, spec=spec)
+    template = (template_models.params_g, template_models.state_g, template_cond)
+    with np.load(os.path.join(path, _ARRAYS)) as data:
+        params_g, state_g, cond = _load_leaves(template, data)
+    return SavedSynthesizer(
+        params_g, state_g, cond, host["transformer"], cfg, spec,
+        key_offset=host.get("key_offset", 17),
+    )

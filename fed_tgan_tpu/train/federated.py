@@ -213,6 +213,7 @@ class FederatedTrainer:
         pooled = np.concatenate(init.client_matrices, axis=0)
         self.server_cond = CondSampler.from_data(pooled, self.spec)
         self.epoch_times: list[float] = []
+        self.completed_epochs = 0
 
     def _shard(self, tree):
         spec = NamedSharding(self.mesh, P(CLIENTS_AXIS))
@@ -229,7 +230,8 @@ class FederatedTrainer:
         steps = self._shard(jnp.asarray(self.steps))
         weights = self._shard(jnp.asarray(self.weights))
 
-        for e in range(epochs):
+        for _ in range(epochs):
+            e = self.completed_epochs  # global round index (survives resume)
             t0 = time.time()
             self._key, ekey = jax.random.split(self._key)
             models, metrics = self._epoch_fn(
@@ -240,6 +242,7 @@ class FederatedTrainer:
             jax.block_until_ready(models)
             self.models = models
             self.epoch_times.append(time.time() - t0)
+            self.completed_epochs += 1
             if log_every and (e % log_every == 0):
                 m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
                 print(

@@ -95,6 +95,65 @@ def test_cli_end_to_end(tmp_path, toy_frame):
     assert set(snap["color"].unique()) <= {"red", "green", "blue"}
 
 
+def test_cli_save_and_resume(tmp_path, toy_frame):
+    data_p = tmp_path / "toy.csv"
+    toy_frame.to_csv(data_p, index=False)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    base = [
+        sys.executable, "-m", "fed_tgan_tpu.cli",
+        "--datapath", str(data_p),
+        "--dataset", "custom",
+        "--categorical", "color", "flag",
+        "--non-negative", "amount",
+        "--target-column", "flag",
+        "--n-clients", "4",
+        "--batch-size", "50",
+        "--embedding-dim", "16",
+        "--sample-rows", "100",
+        "--backend", "cpu",
+        "--n-virtual-devices", "4",
+        "--out-dir", str(tmp_path),
+        "--save-every", "1",
+        "--save-model",
+        "--quiet",
+    ]
+    first = subprocess.run(
+        base + ["--epochs", "1"],
+        capture_output=True, text=True, env=env, cwd="/root/repo", timeout=600,
+    )
+    assert first.returncode == 0, first.stderr[-3000:]
+    assert (tmp_path / "checkpoint" / "host.pkl").exists()
+
+    # resume with MINIMAL flags: the run identity (name "toy", config) must
+    # come from the checkpoint, not be re-derived from CLI defaults
+    second = subprocess.run(
+        [
+            sys.executable, "-m", "fed_tgan_tpu.cli",
+            "--resume", "--epochs", "3",
+            "--out-dir", str(tmp_path),
+            "--sample-rows", "100",
+            "--backend", "cpu",
+            "--n-virtual-devices", "4",
+            "--save-every", "1",
+            "--save-model",
+            "--quiet",
+        ],
+        capture_output=True, text=True, env=env, cwd="/root/repo", timeout=600,
+    )
+    assert second.returncode == 0, second.stderr[-3000:]
+    assert not (tmp_path / "Intrusion_result").exists()
+    # resumed run continues global epoch numbering: rounds 1 and 2
+    result = tmp_path / "toy_result"
+    assert (result / "toy_synthesis_epoch_1.csv").exists()
+    assert (result / "toy_synthesis_epoch_2.csv").exists()
+    # the sampling artifact loads and samples
+    from fed_tgan_tpu.runtime.checkpoint import load_synthesizer
+
+    synth = load_synthesizer(str(tmp_path / "models" / "synthesizer"))
+    assert synth.sample(50, seed=1).shape == (50, 4)
+
+
 def test_cli_nonzero_rank_exits_cleanly():
     proc = subprocess.run(
         [sys.executable, "-m", "fed_tgan_tpu.cli", "-rank", "1"],
