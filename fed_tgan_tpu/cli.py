@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--alpha", type=float, default=0.5, help="dirichlet skew")
     p.add_argument("--uniform", action="store_true",
                    help="uniform FedAvg instead of similarity-weighted")
+    p.add_argument("--mode", type=str, default="fedavg", choices=["fedavg", "mdgan"],
+                   help="fedavg = Fed-TGAN weight averaging; mdgan = GDTS "
+                        "split-model (shared generator, local discriminators)")
     p.add_argument("--backend", type=str, default=None, choices=[None, "tpu", "cpu"],
                    help="cpu = virtual-device mesh (see --n-virtual-devices)")
     p.add_argument("--n-virtual-devices", type=int, default=8)
@@ -202,7 +205,12 @@ def main(argv=None) -> int:
               f"aggregation weights: {np.round(init.weights, 4).tolist()}")
 
     cfg = TrainConfig(batch_size=args.batch_size, embedding_dim=args.embedding_dim)
-    trainer = FederatedTrainer(init, config=cfg, seed=args.seed)
+    if args.mode == "mdgan":
+        from fed_tgan_tpu.train.mdgan import MDGANTrainer
+
+        trainer = MDGANTrainer(init, config=cfg, seed=args.seed)
+    else:
+        trainer = FederatedTrainer(init, config=cfg, seed=args.seed)
     return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
 
 

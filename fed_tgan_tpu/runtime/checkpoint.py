@@ -52,15 +52,23 @@ def _load_leaves(template, data) -> tuple:
 
 
 def save_federated(trainer, path: str, run_name: str | None = None) -> None:
-    """Write a full-resume checkpoint of a ``FederatedTrainer`` to ``path``.
+    """Write a full-resume checkpoint of a trainer to ``path``.
 
-    ``run_name`` (the dataset/output identity, e.g. "Intrusion") rides along
-    so a resumed run keeps writing to the same output layout without the
-    original CLI flags."""
+    Accepts a ``FederatedTrainer`` (kind "federated") or an ``MDGANTrainer``
+    (kind "mdgan" — the replicated generator bundle plus the per-client
+    discriminator stack).  ``run_name`` (the dataset/output identity, e.g.
+    "Intrusion") rides along so a resumed run keeps writing to the same
+    output layout without the original CLI flags."""
     os.makedirs(path, exist_ok=True)
+    is_mdgan = hasattr(trainer, "gen")
+    if not is_mdgan and not hasattr(trainer, "models"):
+        raise TypeError(
+            f"save_federated expects a FederatedTrainer or MDGANTrainer, "
+            f"got {type(trainer).__name__}"
+        )
     host = {
         "version": FORMAT_VERSION,
-        "kind": "federated",
+        "kind": "mdgan" if is_mdgan else "federated",
         "init": trainer.init,
         "cfg": trainer.cfg,
         "seed": trainer.seed,
@@ -70,8 +78,9 @@ def save_federated(trainer, path: str, run_name: str | None = None) -> None:
     }
     with open(os.path.join(path, _HOST), "wb") as f:
         pickle.dump(host, f)
+    state = (trainer.gen, trainer.disc) if is_mdgan else trainer.models
     _save_leaves(
-        trainer.models,
+        state,
         {"rng_key": jax.random.key_data(trainer._key)},
         path,
     )
@@ -86,10 +95,12 @@ def load_federated(path: str, mesh=None):
     is overwritten from the checkpoint.
     """
     from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.mdgan import MDGANTrainer
 
     with open(os.path.join(path, _HOST), "rb") as f:
         host = pickle.load(f)
-    if host.get("kind") != "federated":
+    kind = host.get("kind")
+    if kind not in ("federated", "mdgan"):
         raise ValueError(f"{path} is not a federated checkpoint")
     if host["version"] > FORMAT_VERSION:
         raise ValueError(
@@ -97,11 +108,15 @@ def load_federated(path: str, mesh=None):
             f"{FORMAT_VERSION}"
         )
 
-    trainer = FederatedTrainer(
-        host["init"], config=host["cfg"], mesh=mesh, seed=host["seed"]
-    )
+    cls = MDGANTrainer if kind == "mdgan" else FederatedTrainer
+    trainer = cls(host["init"], config=host["cfg"], mesh=mesh, seed=host["seed"])
     with np.load(os.path.join(path, _ARRAYS)) as data:
-        trainer.models = _load_leaves(trainer.models, data)
+        if kind == "mdgan":
+            trainer.gen, trainer.disc = _load_leaves(
+                (trainer.gen, trainer.disc), data
+            )
+        else:
+            trainer.models = _load_leaves(trainer.models, data)
         trainer._key = jax.random.wrap_key_data(data["rng_key"])
     trainer.completed_epochs = host["completed_epochs"]
     trainer.epoch_times = list(host["epoch_times"])

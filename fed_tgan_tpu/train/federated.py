@@ -67,6 +67,39 @@ def _stack_samplers(samplers):
     return jax.tree.unflatten(treedef, stacked)
 
 
+def build_client_stacks(init: FederatedInit, cfg: TrainConfig, spec: SegmentSpec):
+    """Per-client tables stacked along the clients axis, shared by both
+    trainer engines: (cond_stack, rows_stack, data_stack, steps, server_cond).
+
+    ``steps`` follows the reference's ``len(train) // batch_size`` per client
+    (distributed.py:304); a shard smaller than one batch would train 0 steps,
+    which the reference silently allows but we reject."""
+    conds = [CondSampler.from_data(m, spec) for m in init.client_matrices]
+    rows = [RowSampler.from_data(m, spec) for m in init.client_matrices]
+    cond_stack = _stack_samplers(conds)
+    rows_stack = _stack_samplers(rows)
+    max_rows = max(len(m) for m in init.client_matrices)
+    data_stack = np.stack(
+        [_pad_to(m, max_rows) for m in init.client_matrices]
+    ).astype(np.float32)
+    steps = np.asarray(
+        [len(m) // cfg.batch_size for m in init.client_matrices], dtype=np.int32
+    )
+    if (steps == 0).any():
+        small = [i for i, s in enumerate(steps) if s == 0]
+        raise ValueError(
+            f"clients {small} hold fewer than batch_size={cfg.batch_size} rows "
+            "(reference behavior: they would train 0 steps); rebalance shards "
+            "or shrink the batch"
+        )
+    # generation-time conditional draws use the pooled empirical frequencies
+    # (the reference server rebuilds Cond on the full training table,
+    # distributed.py:565-580)
+    pooled = np.concatenate(init.client_matrices, axis=0)
+    server_cond = CondSampler.from_data(pooled, spec)
+    return cond_stack, rows_stack, data_stack, steps, server_cond
+
+
 def make_federated_epoch(
     spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, k: int
 ):
@@ -163,27 +196,8 @@ class FederatedTrainer:
 
         self.spec = SegmentSpec.from_output_info(init.output_info)
 
-        # per-client tables, padded + stacked along the clients axis
-        conds = [CondSampler.from_data(m, self.spec) for m in init.client_matrices]
-        rows = [RowSampler.from_data(m, self.spec) for m in init.client_matrices]
-        self.cond_stack = _stack_samplers(conds)
-        self.rows_stack = _stack_samplers(rows)
-        max_rows = max(len(m) for m in init.client_matrices)
-        self.data_stack = np.stack(
-            [_pad_to(m, max_rows) for m in init.client_matrices]
-        ).astype(np.float32)
-
-        self.steps = np.asarray(
-            [len(m) // self.cfg.batch_size for m in init.client_matrices],
-            dtype=np.int32,
-        )
-        if (self.steps == 0).any():
-            small = [i for i, s in enumerate(self.steps) if s == 0]
-            raise ValueError(
-                f"clients {small} hold fewer than batch_size="
-                f"{self.cfg.batch_size} rows (reference behavior: they would "
-                "train 0 steps); rebalance shards or shrink the batch"
-            )
+        (self.cond_stack, self.rows_stack, self.data_stack, self.steps,
+         self.server_cond) = build_client_stacks(init, self.cfg, self.spec)
         self.max_steps = int(self.steps.max())
         self.weights = np.asarray(init.weights, dtype=np.float32)
 
@@ -207,11 +221,6 @@ class FederatedTrainer:
             self.spec, self.cfg,
             decode_fn=make_device_decode(init.transformers[0].columns),
         )
-        # generation-time conditional draws use the pooled empirical
-        # frequencies (the reference server rebuilds Cond on the full
-        # training table, distributed.py:565-580)
-        pooled = np.concatenate(init.client_matrices, axis=0)
-        self.server_cond = CondSampler.from_data(pooled, self.spec)
         self.epoch_times: list[float] = []
         self.completed_epochs = 0
 

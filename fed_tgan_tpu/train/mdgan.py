@@ -1,0 +1,327 @@
+"""MD-GAN / GDTS split-model training — the reference's legacy path, TPU-native.
+
+The reference keeps a dead-but-documented MD-GAN architecture (reference
+Server/dtds/distributed.py:421-525 ``train_D``/``loss_G``; the GDTS paper's
+design): ONE generator lives on the server, every client trains only a local
+discriminator, and each client step fetches a fake batch from the server via
+``G_rref.remote().forward(fakez).to_here()`` — one RPC round trip per batch,
+timed into ``time_train_d.csv``/``time_loss_g.csv`` (:449-457, :501-508).
+The generator is then updated from the clients' feedback through distributed
+autograd; discriminators are never exchanged.
+
+The TPU-native re-expression removes the per-step process boundary entirely:
+
+- the single server generator becomes a **replicated** parameter pytree on the
+  ``clients`` mesh — every device holds the same G, so "fetch a fake batch
+  from the server" is a local forward of the shared weights (bitwise the same
+  computation, zero communication);
+- discriminators stay **sharded**, one per participant, and are never averaged
+  (MD-GAN semantics — contrast with ``train.federated`` where D is FedAvg'd);
+- the generator update is the clients' feedback: every client computes
+  dL_G/dtheta_G against its own local D, the gradients are ``psum``-averaged
+  over the mesh axis (one collective per step — the only communication in the
+  whole epoch), and one shared Adam step keeps G identical everywhere.  This
+  is exactly MD-GAN's server-side aggregation of client losses, minus the RPC.
+- BatchNorm running stats of G are likewise psum-averaged over the clients
+  that actually stepped, so the replicated G stays consistent.
+
+Interleaving: the reference's dead driver would run a full epoch of D steps,
+then a full epoch of G steps (train_D :426, loss_G :485 both loop
+``steps_per_epoch``).  Here each scan iteration does one D step then one G
+step (the standard GAN schedule the federated path also uses) — same
+steps-per-epoch totals for both networks, better GAN stability; documented
+deviation from the dead code's phase ordering.
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fed_tgan_tpu.federation.init import FederatedInit
+from fed_tgan_tpu.models.ctgan import discriminator_apply, generator_apply
+from fed_tgan_tpu.models.losses import gradient_penalty
+from fed_tgan_tpu.ops.segments import SegmentSpec, apply_activate, cond_loss
+from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, client_mesh, clients_per_device
+from fed_tgan_tpu.train.federated import build_client_stacks
+from fed_tgan_tpu.train.steps import (
+    SampleProgramCache,
+    TrainConfig,
+    init_models,
+    make_optimizers,
+)
+
+
+class GeneratorBundle(NamedTuple):
+    """The server-side (replicated) half of the split model."""
+
+    params: Any
+    state: Any
+    opt: Any
+
+
+class DiscriminatorBundle(NamedTuple):
+    """One client's local half (leading axis: clients when stacked)."""
+
+    params: Any
+    opt: Any
+
+
+def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, k: int):
+    """Build the jitted one-epoch split-model program.
+
+    Returned fn signature:
+      (gen: GeneratorBundle [replicated], disc: DiscriminatorBundle [sharded],
+       data, cond, rows, steps, key) -> (gen, disc, metrics)
+    """
+    opt_g, opt_d = make_optimizers(cfg)
+    B = cfg.batch_size
+    has_cond = spec.n_discrete > 0
+    n_devices = mesh.devices.size
+
+    def epoch_local(gen: GeneratorBundle, disc: DiscriminatorBundle, data, cond,
+                    rows, steps_i, key):
+        rank = jax.lax.axis_index(CLIENTS_AXIS)
+
+        def one_step(carry, s):
+            g_params, g_state, g_opt, d_params_k, d_opt_k = carry
+
+            def client_step(d_params, d_opt, data_i, cond_i, rows_i, steps_ii, local_idx):
+                keys = jax.random.split(
+                    jax.random.fold_in(jax.random.fold_in(key, rank * k + local_idx), s),
+                    13,
+                )
+                valid = s < steps_ii
+
+                # ---- D step against the shared generator (G frozen here) ----
+                z = jax.random.normal(keys[0], (B, cfg.embedding_dim))
+                if has_cond:
+                    c1, m1, col, opt_idx = cond_i.sample_train(keys[1], B)
+                    perm = jax.random.permutation(keys[2], B)
+                    row_idx = rows_i.sample_rows(keys[3], col[perm], opt_idx[perm])
+                    c2 = c1[perm]
+                    gen_in = jnp.concatenate([z, c1], axis=1)
+                else:
+                    row_idx = rows_i.sample_uniform(keys[3], B)
+                    gen_in = z
+                real = data_i[row_idx]
+
+                fake_raw, _ = generator_apply(g_params, g_state, gen_in, train=True)
+                fake_act = apply_activate(fake_raw, spec, keys[4])
+                if has_cond:
+                    fake_cat = jnp.concatenate([fake_act, c1], axis=1)
+                    real_cat = jnp.concatenate([real, c2], axis=1)
+                else:
+                    fake_cat, real_cat = fake_act, real
+                fake_cat = jax.lax.stop_gradient(fake_cat)
+
+                def d_loss_fn(p):
+                    y_fake = discriminator_apply(p, fake_cat, keys[5], cfg.pac)
+                    y_real = discriminator_apply(p, real_cat, keys[6], cfg.pac)
+                    loss_d = jnp.mean(y_fake) - jnp.mean(y_real)
+                    pen = gradient_penalty(
+                        lambda x: discriminator_apply(p, x, keys[7], cfg.pac),
+                        real_cat, fake_cat, keys[8], pac=cfg.pac,
+                    )
+                    return loss_d + pen, (loss_d, pen)
+
+                (_, (loss_d, pen)), grads_d = jax.value_and_grad(
+                    d_loss_fn, has_aux=True
+                )(d_params)
+                upd_d, d_opt_new = opt_d.update(grads_d, d_opt, d_params)
+                d_params_new = jax.tree.map(lambda p, u: p + u, d_params, upd_d)
+                sel = lambda new, old: jax.tree.map(
+                    lambda a, b: jnp.where(valid, a, b), new, old
+                )
+                d_params_new = sel(d_params_new, d_params)
+                d_opt_new = sel(d_opt_new, d_opt)
+
+                # ---- this client's feedback: dL_G/dG against its local D ----
+                z2 = jax.random.normal(keys[9], (B, cfg.embedding_dim))
+                if has_cond:
+                    c1g, m1g, _, _ = cond_i.sample_train(keys[10], B)
+                    gen_in2 = jnp.concatenate([z2, c1g], axis=1)
+                else:
+                    gen_in2 = z2
+
+                def g_loss_fn(p):
+                    raw, st = generator_apply(p, g_state, gen_in2, train=True)
+                    act = apply_activate(raw, spec, keys[11])
+                    d_in = jnp.concatenate([act, c1g], axis=1) if has_cond else act
+                    y_fake = discriminator_apply(d_params_new, d_in, keys[12], cfg.pac)
+                    ce = cond_loss(raw, spec, c1g, m1g) if has_cond else 0.0
+                    return -jnp.mean(y_fake) + ce, st
+
+                (loss_g, g_state_new), g_grads = jax.value_and_grad(
+                    g_loss_fn, has_aux=True
+                )(g_params)
+                w = valid.astype(jnp.float32)
+                g_grads = jax.tree.map(lambda g: g * w, g_grads)
+                g_state_c = jax.tree.map(lambda st: st * w, g_state_new)
+                metrics = {
+                    "loss_d": jnp.where(valid, loss_d, 0.0),
+                    "pen": jnp.where(valid, pen, 0.0),
+                    "loss_g": jnp.where(valid, loss_g, 0.0),
+                }
+                return d_params_new, d_opt_new, g_grads, g_state_c, w, metrics
+
+            d_params_k, d_opt_k, g_grads_k, g_state_k, w_k, metrics = jax.vmap(
+                client_step
+            )(d_params_k, d_opt_k, data, cond, rows, steps_i, jnp.arange(k))
+
+            # ---- server role: aggregate feedback over every participant ----
+            n_valid = jax.lax.psum(w_k.sum(), CLIENTS_AXIS)
+            denom = jnp.maximum(n_valid, 1.0)
+            g_grads = jax.tree.map(
+                lambda g: jax.lax.psum(g.sum(axis=0), CLIENTS_AXIS) / denom, g_grads_k
+            )
+            g_state_new = jax.tree.map(
+                lambda st: jax.lax.psum(st.sum(axis=0), CLIENTS_AXIS) / denom, g_state_k
+            )
+            upd_g, g_opt_new = opt_g.update(g_grads, g_opt, g_params)
+            g_params_new = jax.tree.map(lambda p, u: p + u, g_params, upd_g)
+            # no participant stepped (s past every client's budget): keep G
+            keep = n_valid > 0
+            pick = lambda new, old: jax.tree.map(
+                lambda a, b: jnp.where(keep, a, b), new, old
+            )
+            g_params = pick(g_params_new, g_params)
+            g_state = pick(g_state_new, g_state)
+            g_opt = pick(g_opt_new, g_opt)
+            return (g_params, g_state, g_opt, d_params_k, d_opt_k), metrics
+
+        carry = (gen.params, gen.state, gen.opt, disc.params, disc.opt)
+        carry, metrics = jax.lax.scan(one_step, carry, jnp.arange(max_steps))
+        g_params, g_state, g_opt, d_params_k, d_opt_k = carry
+        # per-client mean over the steps it actually ran
+        steps_f = jnp.maximum(steps_i.astype(jnp.float32), 1.0)
+        metrics = jax.tree.map(lambda m: m.sum(axis=0) / steps_f, metrics)
+        return (
+            GeneratorBundle(g_params, g_state, g_opt),
+            DiscriminatorBundle(d_params_k, d_opt_k),
+            metrics,
+        )
+
+    rep, shd = P(), P(CLIENTS_AXIS)
+    fn = jax.shard_map(
+        epoch_local,
+        mesh=mesh,
+        in_specs=(rep, shd, shd, shd, shd, shd, rep),
+        out_specs=(rep, shd, shd),
+        check_vma=False,  # G-side outputs are made device-invariant by psum
+    )
+    return jax.jit(fn)
+
+
+class MDGANTrainer:
+    """Split-model (MD-GAN/GDTS) federated training from a ``FederatedInit``.
+
+    Mirrors ``FederatedTrainer``'s surface (fit / sample / sample_encoded)
+    with the split-model engine; ``save_time_stamp`` writes the per-epoch
+    wall-clock files the reference's MD-GAN clients kept
+    (reference Server/dtds/distributed.py:527-534) — one row per epoch here,
+    since the per-batch RPC those files timed no longer exists.
+    """
+
+    def __init__(self, init: FederatedInit, config: TrainConfig | None = None,
+                 mesh=None, seed: int = 0):
+        self.init = init
+        self.cfg = config or TrainConfig()
+        self.seed = seed
+        n_clients = len(init.client_matrices)
+        self.n_clients = n_clients
+        if mesh is None:
+            n_dev = len(jax.devices())
+            mesh = client_mesh(n_clients if n_clients < n_dev else None)
+        self.mesh = mesh
+        self.k = clients_per_device(n_clients, mesh)
+        self.spec = SegmentSpec.from_output_info(init.output_info)
+
+        (self.cond_stack, self.rows_stack, self.data_stack, self.steps,
+         self.server_cond) = build_client_stacks(init, self.cfg, self.spec)
+        self.max_steps = int(self.steps.max())
+
+        one = init_models(jax.random.key(seed + 1), self.spec, self.cfg)
+        self.gen = GeneratorBundle(one.params_g, one.state_g, one.opt_g)
+        stack = lambda t: jax.tree.map(
+            lambda x: np.broadcast_to(
+                np.asarray(x)[None], (n_clients,) + np.shape(x)
+            ).copy(),
+            t,
+        )
+        self.disc = DiscriminatorBundle(stack(one.params_d), stack(one.opt_d))
+
+        self._key = jax.random.key(seed)
+        self._epoch_fn = make_mdgan_epoch(
+            self.spec, self.cfg, self.max_steps, self.mesh, self.k
+        )
+        from fed_tgan_tpu.ops.decode import make_device_decode
+
+        self._encoded_cache = SampleProgramCache(self.spec, self.cfg)
+        self._decoded_cache = SampleProgramCache(
+            self.spec, self.cfg,
+            decode_fn=make_device_decode(init.transformers[0].columns),
+        )
+        self.epoch_times: list[float] = []
+        self.completed_epochs = 0
+
+    def fit(self, epochs: int, log_every: int = 0, sample_hook=None):
+        shard = lambda t: jax.device_put(
+            t, NamedSharding(self.mesh, P(CLIENTS_AXIS))
+        )
+        rep = lambda t: jax.device_put(t, NamedSharding(self.mesh, P()))
+        gen = rep(self.gen)
+        disc = shard(self.disc)
+        data = shard(jnp.asarray(self.data_stack))
+        cond = shard(self.cond_stack)
+        rows = shard(self.rows_stack)
+        steps = shard(jnp.asarray(self.steps))
+
+        for _ in range(epochs):
+            t0 = time.time()
+            self._key, ekey = jax.random.split(self._key)
+            gen, disc, metrics = self._epoch_fn(gen, disc, data, cond, rows, steps, ekey)
+            jax.block_until_ready(gen)
+            self.gen, self.disc = gen, disc
+            self.epoch_times.append(time.time() - t0)
+            e = self.completed_epochs
+            self.completed_epochs += 1
+            if log_every and e % log_every == 0:
+                m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
+                print(
+                    f"mdgan round {e}: loss_d={m['loss_d']:.3f} "
+                    f"loss_g={m['loss_g']:.3f} ({self.epoch_times[-1]:.3f}s)"
+                )
+            if sample_hook is not None:
+                sample_hook(e, self)
+        return self
+
+    def _global_model(self):
+        """The shared (server-held) generator — already global by design."""
+        return self.gen.params, self.gen.state
+
+    def sample_encoded(self, n: int, seed: int = 0) -> np.ndarray:
+        return self._encoded_cache.sample(
+            self.gen.params, self.gen.state, self.server_cond, n,
+            jax.random.key(seed + 29),
+        )
+
+    def sample(self, n: int, seed: int = 0) -> np.ndarray:
+        out = self._decoded_cache.sample(
+            self.gen.params, self.gen.state, self.server_cond, n,
+            jax.random.key(seed + 29),
+        )
+        return np.asarray(out).astype(np.float64)
+
+    def save_time_stamp(self, out_dir: str = ".") -> None:
+        import os
+
+        for fname in ("time_train_d.csv", "time_loss_g.csv"):
+            with open(os.path.join(out_dir, fname), "w") as f:
+                csv.writer(f).writerows([[t] for t in self.epoch_times])
