@@ -47,7 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--alpha", type=float, default=0.5, help="dirichlet skew")
     p.add_argument("--uniform", action="store_true",
                    help="uniform FedAvg instead of similarity-weighted")
-    p.add_argument("--mode", type=str, default="fedavg", choices=["fedavg", "mdgan"],
+    p.add_argument("--mode", type=str, default="fedavg",
+                   choices=["fedavg", "mdgan", "standalone"],
                    help="fedavg = Fed-TGAN weight averaging; mdgan = GDTS "
                         "split-model (shared generator, local discriminators)")
     p.add_argument("--backend", type=str, default=None, choices=[None, "tpu", "cpu"],
@@ -171,15 +172,18 @@ def main(argv=None) -> int:
         n_clients = len(frames)
     else:
         df = pd.read_csv(args.datapath)
-        label_col = kwargs.get("target_column") or None
-        frames = shard_dataframe(
-            df,
-            n_clients,
-            args.shard_strategy,
-            label_column=label_col if args.shard_strategy in ("label_sorted", "dirichlet") else None,
-            alpha=args.alpha,
-            seed=args.seed,
-        )
+        if args.mode == "standalone":
+            frames = [df]  # one participant: no sharding work to undo later
+        else:
+            label_col = kwargs.get("target_column") or None
+            frames = shard_dataframe(
+                df,
+                n_clients,
+                args.shard_strategy,
+                label_column=label_col if args.shard_strategy in ("label_sorted", "dirichlet") else None,
+                alpha=args.alpha,
+                seed=args.seed,
+            )
 
     selected = kwargs.pop("selected_columns", None)
     # every participant must present the same schema — harmonization merges
@@ -191,6 +195,11 @@ def main(argv=None) -> int:
             print(f"client {i}: input is missing columns {missing}")
             return 2
     columns = list(selected) if selected else list(frames[0].columns)
+    cfg = TrainConfig(batch_size=args.batch_size, embedding_dim=args.embedding_dim)
+    if args.mode == "standalone":
+        # no participants, no harmonization/refit protocol — skip the
+        # federated construction entirely
+        return _run_standalone(args, name, kwargs, frames, columns, cfg)
     clients = [
         TablePreprocessor(frame=f, name=name, selected_columns=columns, **kwargs)
         for f in frames
@@ -204,7 +213,6 @@ def main(argv=None) -> int:
         print(f"init done in {time.time() - t_init:.1f}s; "
               f"aggregation weights: {np.round(init.weights, 4).tolist()}")
 
-    cfg = TrainConfig(batch_size=args.batch_size, embedding_dim=args.embedding_dim)
     if args.mode == "mdgan":
         from fed_tgan_tpu.train.mdgan import MDGANTrainer
 
@@ -212,6 +220,59 @@ def main(argv=None) -> int:
     else:
         trainer = FederatedTrainer(init, config=cfg, seed=args.seed)
     return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
+
+
+def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
+    """Non-federated path: one participant, local BGM transformer, no
+    harmonization/refit protocol — the working equivalent of the reference's
+    broken ``local.py`` driver around ``CTGANSynthesizer.fit/sample``
+    (reference Server/dtds/local.py:1-48, Server/dtds/synthesizers/ctgan.py:
+    309-488)."""
+    import pandas as pd
+
+    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.federation.init import harmonize_categories
+    from fed_tgan_tpu.train.standalone import StandaloneSynthesizer
+
+    df = pd.concat(frames) if len(frames) > 1 else frames[0]
+    pre = TablePreprocessor(frame=df, name=name, selected_columns=columns, **kwargs)
+    # single-participant "harmonization" = frequency-ordered vocab + encoders
+    meta, encoders, _ = harmonize_categories([pre.local_meta()])
+    matrix, cat_idx, ord_idx = pre.encode(encoders)
+
+    synth = StandaloneSynthesizer(config=cfg, seed=args.seed, verbose=not args.quiet)
+    t0 = time.time()
+    synth.fit(matrix, cat_idx, ord_idx, epochs=args.epochs)
+    if not args.quiet:
+        print(f"standalone fit: {args.epochs} epochs in {time.time() - t0:.1f}s")
+
+    result_dir = os.path.join(args.out_dir, f"{name}_result")
+    os.makedirs(result_dir, exist_ok=True)
+    table_meta = pre.global_table_meta(meta)
+    decoded = synth.sample(args.sample_rows, seed=args.seed)
+    raw = decode_matrix(decoded, table_meta, encoders)
+    out_csv = os.path.join(result_dir, f"{name}_synthesis_standalone.csv")
+    raw.to_csv(out_csv, index=False)
+    if not args.quiet:
+        print(f"wrote {len(raw)} rows to {out_csv}")
+
+    if args.save_model:
+        from fed_tgan_tpu.runtime.checkpoint import save_synthesizer
+
+        models_dir = os.path.join(args.out_dir, "models")
+        os.makedirs(models_dir, exist_ok=True)
+        save_synthesizer(synth, os.path.join(models_dir, "synthesizer"))
+
+    if args.eval:
+        from fed_tgan_tpu.eval.similarity import statistical_similarity
+
+        real = df[raw.columns.tolist()]
+        avg_jsd, avg_wd, _ = statistical_similarity(
+            real, raw, kwargs["categorical_columns"]
+        )
+        print(f"final Avg_JSD={avg_jsd:.4f} Avg_WD={avg_wd:.4f}")
+    return 0
 
 
 def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
@@ -268,8 +329,11 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
 
         save_synthesizer(trainer, os.path.join(models_dir, "synthesizer"))
 
-    with open(os.path.join(args.out_dir, "timestamp_experiment.csv"), "w") as f:
-        csv.writer(f).writerows([[t] for t in trainer.epoch_times])
+    if hasattr(trainer, "write_timing"):
+        trainer.write_timing(args.out_dir)
+    else:
+        with open(os.path.join(args.out_dir, "timestamp_experiment.csv"), "w") as f:
+            csv.writer(f).writerows([[t] for t in trainer.epoch_times])
 
     if args.eval and frames is not None:
         from fed_tgan_tpu.eval.similarity import statistical_similarity

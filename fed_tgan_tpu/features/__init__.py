@@ -1,4 +1,20 @@
 from fed_tgan_tpu.features.bgm import ColumnGMM, fit_column_gmm
 from fed_tgan_tpu.features.transformer import ModeNormalizer
+from fed_tgan_tpu.features.zoo import (
+    BGMTransformer,
+    BinningTransformer,
+    GMMTransformer,
+    GridTransformer,
+    MinMaxTransformer,
+)
 
-__all__ = ["ColumnGMM", "ModeNormalizer", "fit_column_gmm"]
+__all__ = [
+    "BGMTransformer",
+    "BinningTransformer",
+    "ColumnGMM",
+    "GMMTransformer",
+    "GridTransformer",
+    "MinMaxTransformer",
+    "ModeNormalizer",
+    "fit_column_gmm",
+]

@@ -1,0 +1,212 @@
+"""Fused Pallas TPU kernel for the CTGAN output activation.
+
+``apply_activate`` (reference Server/dtds/synthesizers/ctgan.py:67-82) is the
+per-step elementwise+reduction hot op applied to every generator output: tanh
+on continuous scalar dims, gumbel-softmax (tau=0.2) within every one-hot
+segment.  The XLA path (`ops.segments.apply_activate`) lowers the segmented
+softmax to gather/segment_sum chains; this module instead fuses the whole op
+— noise add, numerically-stable segmented softmax, tanh, and the mask select
+— into ONE Pallas kernel with a single HBM read and write per tensor.
+
+TPU mapping:
+- the segmented reduction is expressed as two small matmuls against a static
+  0/1 membership matrix ``M`` (dim x n_softmax_segments):
+  ``seg_sum = e @ M`` and ``broadcast-back = (e @ M) @ M.T`` — both land on
+  the MXU instead of scatter/gather on the VPU;
+- per-row numerical stability uses the ROW-GLOBAL max: subtracting one
+  constant per row cancels inside every segment's softmax, so no per-segment
+  max pass is needed;
+- the backward pass is an analytic kernel (custom_vjp): for softmax dims
+  ``dx = soft * (dy - seg_sum(dy * soft)) / tau``, for tanh dims
+  ``dx = (1 - out^2) * dy`` — the forward OUTPUT is the only residual.
+
+Gumbel noise is generated outside the kernel with ``jax.random`` (XLA fuses
+it into the surrounding graph); that keeps the Pallas and XLA paths
+bit-comparable under the same key and sidesteps ``pltpu.prng_*``'s lack of an
+interpret-mode lowering on CPU, where the test suite runs.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from fed_tgan_tpu.ops.segments import GUMBEL_TAU, SegmentSpec
+
+_LANE = 128  # TPU lane width: last-dim tiles are always 128 wide
+_SUBLANE = 8  # float32 sublane quantum
+_DEF_BLOCK_ROWS = 256
+
+
+def _round_up(n: int, q: int) -> int:
+    return ((n + q - 1) // q) * q
+
+
+@functools.lru_cache(maxsize=64)
+def _spec_constants(spec: SegmentSpec):
+    """Padded static operands for a given table layout.
+
+    Returns (dim_p, nseg_p, membership M (dim_p, nseg_p) f32,
+    aux (2, dim_p) f32 with row0 = tanh mask, row1 = valid-lane mask).
+    Only softmax segments get a column in M; tanh dims (and padding lanes)
+    have an all-zero row, so their denominator broadcast is 0 and the kernel
+    selects the tanh/zero branch for them instead.
+    """
+    dim_p = _round_up(max(spec.dim, _LANE), _LANE)
+    softmax_segments = [s for s, (_, kind) in enumerate(spec.output_info) if kind == "softmax"]
+    nseg_p = _round_up(max(len(softmax_segments), _LANE), _LANE)
+    col_of = {seg: j for j, seg in enumerate(softmax_segments)}
+    m = np.zeros((dim_p, nseg_p), dtype=np.float32)
+    for d in range(spec.dim):
+        seg = int(spec.segment_ids[d])
+        if not spec.is_tanh_dim[d]:
+            m[d, col_of[seg]] = 1.0
+    aux = np.zeros((2, dim_p), dtype=np.float32)
+    aux[0, : spec.dim] = spec.is_tanh_dim.astype(np.float32)
+    aux[1, : spec.dim] = 1.0
+    # softmax-column id per dim (nseg_p = "no segment" bucket, dropped after
+    # the segment_max that feeds the kernel's stabilization input)
+    col_ids = np.full(dim_p, nseg_p, dtype=np.int32)
+    for d in range(spec.dim):
+        if not spec.is_tanh_dim[d]:
+            col_ids[d] = col_of[int(spec.segment_ids[d])]
+    return dim_p, nseg_p, m, aux, col_ids
+
+
+def _fwd_kernel(x_ref, g_ref, smax_ref, m_ref, aux_ref, out_ref):
+    x = x_ref[:]
+    tanh_mask = aux_ref[0, :][None, :]
+    valid = aux_ref[1, :][None, :]
+    softmax_mask = valid * (1.0 - tanh_mask)
+    noisy = (x + g_ref[:]) * (1.0 / GUMBEL_TAU) * softmax_mask
+    # per-segment max (precomputed on host graph) broadcast back to dims via
+    # the membership matmul: each dim belongs to at most one segment.  A
+    # row-global max would let a far-away tanh dim or another segment push
+    # exp() into float32 underflow and zero out a whole segment.
+    m_bcast = jnp.dot(
+        smax_ref[:], m_ref[:].T,
+        preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST,
+    )
+    e = jnp.exp(noisy - m_bcast) * softmax_mask
+    seg = jnp.dot(e, m_ref[:], preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
+    denom = jnp.dot(seg, m_ref[:].T, preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
+    soft = e / (denom + (denom == 0.0))
+    out_ref[:] = jnp.where(tanh_mask > 0.0, jnp.tanh(x), soft) * valid
+
+
+def _bwd_kernel(dy_ref, out_ref, m_ref, aux_ref, dx_ref):
+    dy = dy_ref[:]
+    out = out_ref[:]
+    tanh_mask = aux_ref[0, :][None, :]
+    valid = aux_ref[1, :][None, :]
+    soft = jnp.where(tanh_mask > 0.0, 0.0, out)  # softmax dims of the fwd output
+    t = dy * soft
+    seg = jnp.dot(t, m_ref[:], preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
+    inner = jnp.dot(seg, m_ref[:].T, preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST)
+    dx_soft = soft * (dy - inner) * (1.0 / GUMBEL_TAU)
+    dx_tanh = (1.0 - out * out) * dy
+    dx_ref[:] = jnp.where(tanh_mask > 0.0, dx_tanh, dx_soft) * valid
+
+
+def _call(kernel, a, b, m, aux, interpret: bool):
+    """Shared pallas_call wrapper: grid over row blocks, operands padded.
+
+    ``b`` is either the gumbel noise (fwd, paired with the per-segment max)
+    or the upstream cotangent (bwd); row-shaped operands share one BlockSpec.
+    """
+    rows_p, dim_p = a.shape
+    bb = min(_DEF_BLOCK_ROWS, rows_p)
+    grid = (rows_p // bb,)
+    row_block = lambda i: (i, 0)
+    fixed = lambda i: (0, 0)
+    row_operands = [a] + list(b if isinstance(b, tuple) else (b,))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_p, dim_p), jnp.float32),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bb, x.shape[1]), row_block) for x in row_operands]
+        + [
+            pl.BlockSpec(m.shape, fixed),
+            pl.BlockSpec(aux.shape, fixed),
+        ],
+        out_specs=pl.BlockSpec((bb, dim_p), row_block),
+        interpret=interpret,
+    )(*row_operands, m, aux)
+
+
+def _pad(x: jax.Array, rows_p: int, dim_p: int) -> jax.Array:
+    rows, dim = x.shape
+    return jnp.pad(x.astype(jnp.float32), ((0, rows_p - rows), (0, dim_p - dim)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _activate_padded(x, g, spec: SegmentSpec, interpret: bool):
+    out, _ = _activate_padded_fwd(x, g, spec, interpret)
+    return out
+
+
+def _activate_padded_fwd(x, g, spec, interpret):
+    _, nseg_p, m, aux, col_ids = _spec_constants(spec)
+    # per-softmax-segment max of the scaled logits, computed in the
+    # surrounding XLA graph (cheap; fuses with the noise generation) and fed
+    # to the kernel for numerically exact per-segment stabilization
+    softmax_mask = jnp.asarray((aux[1] > 0) & (aux[0] == 0))[None, :]
+    noisy = jnp.where(softmax_mask, (x + g) * (1.0 / GUMBEL_TAU), -jnp.inf)
+    smax = jax.ops.segment_max(
+        noisy.T, jnp.asarray(col_ids), num_segments=nseg_p + 1, indices_are_sorted=False
+    ).T[:, :nseg_p]
+    smax = jnp.where(jnp.isfinite(smax), smax, 0.0)
+    out = _call(
+        _fwd_kernel, x, (g, smax), jnp.asarray(m), jnp.asarray(aux), interpret
+    )
+    return out, out  # the forward output is the only residual
+
+
+def _activate_padded_bwd(spec, interpret, out, dy):
+    _, _, m, aux, _ = _spec_constants(spec)
+    dx = _call(_bwd_kernel, dy, out, jnp.asarray(m), jnp.asarray(aux), interpret)
+    # noise enters as (x + g)/tau: softmax dims share dx, tanh dims ignore g
+    tanh_mask = jnp.asarray(aux[0, :] > 0.0)[None, :]
+    dg = jnp.where(tanh_mask, 0.0, dx)
+    return dx, dg
+
+
+_activate_padded.defvjp(_activate_padded_fwd, _activate_padded_bwd)
+
+
+def fused_apply_activate(
+    data: jax.Array, spec: SegmentSpec, key: jax.Array, *, interpret: bool = False
+) -> jax.Array:
+    """Drop-in Pallas equivalent of ``ops.segments.apply_activate``.
+
+    Same gumbel draw (``jax.random.uniform`` under ``key``) as the XLA path,
+    so both produce identical outputs for identical inputs.
+    """
+    rows, dim = data.shape
+    dim_p = _spec_constants(spec)[0]
+    rows_p = _round_up(max(rows, _SUBLANE), _SUBLANE)
+    if rows_p > _DEF_BLOCK_ROWS:
+        rows_p = _round_up(rows_p, _DEF_BLOCK_ROWS)
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, data.shape) + 1e-20) + 1e-20)
+    out = _activate_padded(_pad(data, rows_p, dim_p), _pad(g, rows_p, dim_p), spec, interpret)
+    return out[:rows, :dim].astype(data.dtype)
+
+
+def dispatch_mode() -> str:
+    """How ``ops.segments.apply_activate`` should route.
+
+    ``FED_TGAN_TPU_PALLAS`` = ``auto`` (default: kernel on TPU, XLA
+    elsewhere) | ``off`` | ``force`` | ``interpret`` (kernel in interpret
+    mode — used by the test suite to exercise this path on CPU).
+    """
+    mode = os.environ.get("FED_TGAN_TPU_PALLAS", "auto")
+    if mode not in ("auto", "off", "force", "interpret"):
+        raise ValueError(f"FED_TGAN_TPU_PALLAS={mode!r} not in auto/off/force/interpret")
+    if mode == "auto":
+        return "force" if jax.default_backend() == "tpu" else "off"
+    return mode

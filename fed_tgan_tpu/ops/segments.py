@@ -101,7 +101,7 @@ def _segment_softmax(x: jax.Array, segment_ids: np.ndarray, n_segments: int) -> 
     return e / s[segment_ids].T
 
 
-def apply_activate(data: jax.Array, spec: SegmentSpec, key: jax.Array) -> jax.Array:
+def apply_activate_xla(data: jax.Array, spec: SegmentSpec, key: jax.Array) -> jax.Array:
     """tanh on scalar dims, gumbel-softmax (tau=0.2) on one-hot segments.
 
     Equivalent of reference ctgan.py:67-82 with F.gumbel_softmax semantics
@@ -110,6 +110,19 @@ def apply_activate(data: jax.Array, spec: SegmentSpec, key: jax.Array) -> jax.Ar
     noisy = (data + g) / GUMBEL_TAU
     soft = _segment_softmax(noisy, spec.segment_ids, spec.n_segments)
     return jnp.where(jnp.asarray(spec.is_tanh_dim), jnp.tanh(data), soft)
+
+
+def apply_activate(data: jax.Array, spec: SegmentSpec, key: jax.Array) -> jax.Array:
+    """Dispatch: fused Pallas kernel on TPU, XLA segment ops elsewhere.
+
+    Both paths draw the same gumbel noise from ``key`` and produce identical
+    values; see ``ops.activate_pallas`` for the kernel."""
+    from fed_tgan_tpu.ops import activate_pallas  # local import: avoids cycle
+
+    mode = activate_pallas.dispatch_mode()
+    if data.ndim == 2 and mode != "off":
+        return activate_pallas.fused_apply_activate(data, spec, key, interpret=mode == "interpret")
+    return apply_activate_xla(data, spec, key)
 
 
 def segment_argmax_onehot(data: jax.Array, spec: SegmentSpec) -> jax.Array:

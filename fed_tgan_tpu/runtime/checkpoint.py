@@ -74,6 +74,13 @@ def save_federated(trainer, path: str, run_name: str | None = None) -> None:
         "seed": trainer.seed,
         "completed_epochs": trainer.completed_epochs,
         "epoch_times": list(trainer.epoch_times),
+        # a mid-hook save sees the in-flight round's train phase recorded but
+        # not its total; keep only fully-completed rounds so resume stays
+        # consistent with epoch_times
+        "phase_times": {
+            k: list(v)[: len(trainer.epoch_times)]
+            for k, v in getattr(trainer, "phase_times", {}).items()
+        },
         "run_name": run_name,
     }
     with open(os.path.join(path, _HOST), "wb") as f:
@@ -120,6 +127,9 @@ def load_federated(path: str, mesh=None):
         trainer._key = jax.random.wrap_key_data(data["rng_key"])
     trainer.completed_epochs = host["completed_epochs"]
     trainer.epoch_times = list(host["epoch_times"])
+    if hasattr(trainer, "phase_times"):
+        for k, v in host.get("phase_times", {}).items():
+            trainer.phase_times[k] = list(v)
     trainer.run_name = host.get("run_name")
     return trainer
 

@@ -161,11 +161,71 @@ def make_federated_epoch(
         mesh=mesh,
         in_specs=(sharded, sharded, sharded, sharded, sharded, sharded, P()),
         out_specs=(sharded, sharded),
+        # the fused Pallas activation can't declare per-axis varying-ness on
+        # its out_shape; its outputs are strictly per-client row blocks
+        check_vma=False,
     )
     return jax.jit(fn)
 
 
-class FederatedTrainer:
+class RoundBookkeeping:
+    """Per-round timing/hook bookkeeping shared by both training engines.
+
+    Invariant: ``epoch_times`` and both ``phase_times`` lists stay length ==
+    ``completed_epochs`` at EVERY point — including while the sample hook is
+    running, so a checkpoint taken inside the hook (cli --save-every) always
+    sees a consistent trainer.  Like the reference, the per-round timestamp
+    covers the whole round: local steps + aggregation + snapshot/distribution
+    (reference Server/dtds/distributed.py:796,824)."""
+
+    def _init_bookkeeping(self) -> None:
+        self.epoch_times: list[float] = []
+        self.phase_times: dict[str, list[float]] = {
+            "train_aggregate": [],
+            "distribution": [],
+        }
+        self.completed_epochs = 0
+
+    def _finish_round(self, t_round: float, e: int, sample_hook) -> None:
+        self.phase_times["train_aggregate"].append(t_round)
+        self.phase_times["distribution"].append(0.0)
+        self.epoch_times.append(t_round)
+        self.completed_epochs += 1
+        if sample_hook is not None:
+            t1 = time.time()
+            sample_hook(e, self)
+            t_hook = time.time() - t1
+            self.phase_times["distribution"][-1] = t_hook
+            self.epoch_times[-1] = t_round + t_hook
+
+    def write_timing(self, out_dir: str = ".") -> None:
+        """``timestamp_experiment.csv`` — one wall-clock value per round
+        (reference distributed.py:827-829, excel dialect, single column) —
+        plus ``timing_phases.csv`` with the per-phase breakdown the reference
+        collects but never writes (distributed.py:790-824)."""
+        import csv
+        import os
+
+        with open(os.path.join(out_dir, "timestamp_experiment.csv"), "w") as f:
+            csv.writer(f).writerows([[t] for t in self.epoch_times])
+        n = len(self.epoch_times)
+
+        def pick(lst, i):
+            # phase lists may cover fewer rounds than epoch_times (e.g. a
+            # checkpoint predating this instrumentation); align by tail
+            j = i - (n - len(lst))
+            return lst[j] if 0 <= j < len(lst) else ""
+
+        ta = self.phase_times["train_aggregate"]
+        td = self.phase_times["distribution"]
+        with open(os.path.join(out_dir, "timing_phases.csv"), "w") as f:
+            w = csv.writer(f)
+            w.writerow(["epoch", "train_aggregate_s", "distribution_s", "total_s"])
+            for i, t in enumerate(self.epoch_times):
+                w.writerow([i, pick(ta, i), pick(td, i), t])
+
+
+class FederatedTrainer(RoundBookkeeping):
     """End-to-end federated training from a completed ``FederatedInit``."""
 
     def __init__(
@@ -221,8 +281,13 @@ class FederatedTrainer:
             self.spec, self.cfg,
             decode_fn=make_device_decode(init.transformers[0].columns),
         )
-        self.epoch_times: list[float] = []
-        self.completed_epochs = 0
+        # per-phase breakdown like the reference server's fit() lists
+        # (time_training/time_aggregation/time_distribution, reference
+        # Server/dtds/distributed.py:790-824).  Local train + weighted psum
+        # aggregation are ONE fused device program here, so they share a
+        # phase; "distribution" covers the per-round snapshot/sampling work
+        # (weight broadcast is free — the psum result is already replicated).
+        self._init_bookkeeping()
 
     def _shard(self, tree):
         spec = NamedSharding(self.mesh, P(CLIENTS_AXIS))
@@ -250,16 +315,13 @@ class FederatedTrainer:
             # round's real wall-clock, not async dispatch latency
             jax.block_until_ready(models)
             self.models = models
-            self.epoch_times.append(time.time() - t0)
-            self.completed_epochs += 1
+            self._finish_round(time.time() - t0, e, sample_hook)
             if log_every and (e % log_every == 0):
                 m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
                 print(
                     f"round {e}: loss_d={m['loss_d']:.3f} pen={m['pen']:.3f} "
                     f"loss_g={m['loss_g']:.3f} ({self.epoch_times[-1]:.3f}s)"
                 )
-            if sample_hook is not None:
-                sample_hook(e, self)
         jax.block_until_ready(models)
         self.models = models
         return self

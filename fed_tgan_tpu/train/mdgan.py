@@ -49,7 +49,7 @@ from fed_tgan_tpu.models.ctgan import discriminator_apply, generator_apply
 from fed_tgan_tpu.models.losses import gradient_penalty
 from fed_tgan_tpu.ops.segments import SegmentSpec, apply_activate, cond_loss
 from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, client_mesh, clients_per_device
-from fed_tgan_tpu.train.federated import build_client_stacks
+from fed_tgan_tpu.train.federated import RoundBookkeeping, build_client_stacks
 from fed_tgan_tpu.train.steps import (
     SampleProgramCache,
     TrainConfig,
@@ -112,7 +112,7 @@ def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, 
                     gen_in = z
                 real = data_i[row_idx]
 
-                fake_raw, _ = generator_apply(g_params, g_state, gen_in, train=True)
+                fake_raw, g_state_d = generator_apply(g_params, g_state, gen_in, train=True)
                 fake_act = apply_activate(fake_raw, spec, keys[4])
                 if has_cond:
                     fake_cat = jnp.concatenate([fake_act, c1], axis=1)
@@ -151,7 +151,9 @@ def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, 
                     gen_in2 = z2
 
                 def g_loss_fn(p):
-                    raw, st = generator_apply(p, g_state, gen_in2, train=True)
+                    # thread the D-step's BN update into the G step, exactly
+                    # like make_train_step (steps.py) does with state_g2
+                    raw, st = generator_apply(p, g_state_d, gen_in2, train=True)
                     act = apply_activate(raw, spec, keys[11])
                     d_in = jnp.concatenate([act, c1g], axis=1) if has_cond else act
                     y_fake = discriminator_apply(d_params_new, d_in, keys[12], cfg.pac)
@@ -219,7 +221,7 @@ def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, 
     return jax.jit(fn)
 
 
-class MDGANTrainer:
+class MDGANTrainer(RoundBookkeeping):
     """Split-model (MD-GAN/GDTS) federated training from a ``FederatedInit``.
 
     Mirrors ``FederatedTrainer``'s surface (fit / sample / sample_encoded)
@@ -268,8 +270,9 @@ class MDGANTrainer:
             self.spec, self.cfg,
             decode_fn=make_device_decode(init.transformers[0].columns),
         )
-        self.epoch_times: list[float] = []
-        self.completed_epochs = 0
+        # same per-phase split and timing-file contract as FederatedTrainer
+        # so --mode mdgan numbers are comparable with fedavg runs
+        self._init_bookkeeping()
 
     def fit(self, epochs: int, log_every: int = 0, sample_hook=None):
         shard = lambda t: jax.device_put(
@@ -289,17 +292,14 @@ class MDGANTrainer:
             gen, disc, metrics = self._epoch_fn(gen, disc, data, cond, rows, steps, ekey)
             jax.block_until_ready(gen)
             self.gen, self.disc = gen, disc
-            self.epoch_times.append(time.time() - t0)
             e = self.completed_epochs
-            self.completed_epochs += 1
+            self._finish_round(time.time() - t0, e, sample_hook)
             if log_every and e % log_every == 0:
                 m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
                 print(
                     f"mdgan round {e}: loss_d={m['loss_d']:.3f} "
                     f"loss_g={m['loss_g']:.3f} ({self.epoch_times[-1]:.3f}s)"
                 )
-            if sample_hook is not None:
-                sample_hook(e, self)
         return self
 
     def _global_model(self):

@@ -93,3 +93,25 @@ def test_weighted_matches_manual_average(fed_init):
     first_leaf = lambda m: np.asarray(jax.tree.leaves(m.params_g)[0])
     manual = sum(tr.weights[c] * first_leaf(per_client[c]) for c in range(4))
     assert np.allclose(avg, manual, atol=1e-4)
+
+
+def test_timing_instrumentation(fed_init, tmp_path):
+    mesh = client_mesh(4)
+    tr = FederatedTrainer(fed_init, config=CFG, mesh=mesh, seed=0)
+    hooked = []
+    tr.fit(epochs=2, sample_hook=lambda e, t: hooked.append(e))
+    assert hooked == [0, 1]
+    assert len(tr.epoch_times) == 2
+    assert len(tr.phase_times["train_aggregate"]) == 2
+    assert len(tr.phase_times["distribution"]) == 2
+    # round total covers both phases (reference distributed.py:796,824)
+    for i in range(2):
+        total = tr.phase_times["train_aggregate"][i] + tr.phase_times["distribution"][i]
+        assert abs(tr.epoch_times[i] - total) < 1e-6
+
+    tr.write_timing(str(tmp_path))
+    rows = (tmp_path / "timestamp_experiment.csv").read_text().strip().splitlines()
+    assert len(rows) == 2 and float(rows[0]) > 0
+    phases = (tmp_path / "timing_phases.csv").read_text().strip().splitlines()
+    assert phases[0].startswith("epoch,train_aggregate_s,distribution_s,total_s")
+    assert len(phases) == 3

@@ -92,7 +92,7 @@ def test_mdgan_generator_update_is_mean_of_client_grads(fed_init):
         row_idx = rows_c.sample_rows(keys[3], col[perm], opt_idx[perm])
         real = data_c[row_idx]
         gen_in = jnp.concatenate([z, c1], axis=1)
-        fake_raw, _ = generator_apply(g0, gstate0, gen_in, train=True)
+        fake_raw, gstate_d = generator_apply(g0, gstate0, gen_in, train=True)
         fake_act = apply_activate(fake_raw, spec, keys[4])
         fake_cat = jnp.concatenate([fake_act, c1], axis=1)
         real_cat = jnp.concatenate([real, c1[perm]], axis=1)
@@ -115,7 +115,8 @@ def test_mdgan_generator_update_is_mean_of_client_grads(fed_init):
         gen_in2 = jnp.concatenate([z2, c1g], axis=1)
 
         def g_loss_fn(p):
-            raw, st = generator_apply(p, gstate0, gen_in2, train=True)
+            # D-step BN state threads into the G step (as in make_train_step)
+            raw, st = generator_apply(p, gstate_d, gen_in2, train=True)
             act = apply_activate(raw, spec, keys[11])
             y_fake = discriminator_apply(dp_new, jnp.concatenate([act, c1g], axis=1),
                                          keys[12], cfg.pac)
@@ -192,3 +193,22 @@ def test_mdgan_save_time_stamp(fed_init, tmp_path):
     tr.save_time_stamp(str(tmp_path))
     assert (tmp_path / "time_train_d.csv").exists()
     assert (tmp_path / "time_loss_g.csv").exists()
+
+
+def test_mdgan_timing_and_save_time_stamp(fed_init, tmp_path):
+    tr = MDGANTrainer(fed_init, config=CFG, mesh=client_mesh(4), seed=0)
+    hooked = []
+    tr.fit(epochs=2, sample_hook=lambda e, t: hooked.append(e))
+    assert hooked == [0, 1]
+    assert len(tr.epoch_times) == 2
+    # round total covers both phases, same contract as FederatedTrainer
+    for i in range(2):
+        total = tr.phase_times["train_aggregate"][i] + tr.phase_times["distribution"][i]
+        assert abs(tr.epoch_times[i] - total) < 1e-6
+    tr.write_timing(str(tmp_path))
+    assert (tmp_path / "timestamp_experiment.csv").exists()
+    assert (tmp_path / "timing_phases.csv").exists()
+    tr.save_time_stamp(str(tmp_path))
+    for f in ("time_train_d.csv", "time_loss_g.csv"):
+        rows = (tmp_path / f).read_text().strip().splitlines()
+        assert len(rows) == 2
