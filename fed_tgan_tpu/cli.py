@@ -303,18 +303,34 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             index=False,
         )
 
+    def snapshot_due(e: int) -> bool:
+        return bool(args.sample_every) and e % args.sample_every == 0
+
+    def save_due(e: int) -> bool:
+        return bool(args.save_every) and (e + 1) % args.save_every == 0
+
     def hook(e, tr):
-        if args.sample_every and e % args.sample_every == 0:
+        if snapshot_due(e):
             snapshot(e, tr)
-        if args.save_every and (e + 1) % args.save_every == 0:
+        if save_due(e):
             from fed_tgan_tpu.runtime.checkpoint import save_federated
 
             save_federated(tr, ckpt_dir, run_name=name)
 
     # --epochs is the TOTAL round budget; a resumed run does the remainder
     remaining = max(0, args.epochs - trainer.completed_epochs)
+    use_hook = bool(args.sample_every or args.save_every)
+    fit_kwargs = {}
+    if use_hook and hasattr(trainer, "_epoch_fn_for"):
+        # tell the trainer exactly which rounds the hook acts on, so the
+        # hook-free stretches fuse into single device programs
+        start = trainer.completed_epochs
+        fit_kwargs["hook_epochs"] = [
+            e for e in range(start, start + remaining)
+            if snapshot_due(e) or save_due(e)
+        ]
     trainer.fit(remaining, log_every=0 if args.quiet else max(1, remaining // 10),
-                sample_hook=hook if (args.sample_every or args.save_every) else None)
+                sample_hook=hook if use_hook else None, **fit_kwargs)
     last_epoch = trainer.completed_epochs - 1
     if args.sample_every == 0 and last_epoch >= 0:
         snapshot(last_epoch, trainer)

@@ -80,3 +80,71 @@ def similarity_report(
     if epoch_times is not None:
         df["time_stamp"] = np.cumsum(np.asarray(epoch_times, dtype=float))
     return df
+
+
+def _main(argv=None) -> int:
+    """Offline similarity analysis over a run's per-epoch snapshots — the
+    reference's ``similarity_analysis.py`` workflow (reference
+    Server/similarity_analysis.py:88-118) as a module CLI."""
+    import argparse
+    import glob
+    import os
+    import re
+
+    p = argparse.ArgumentParser(
+        description="Per-epoch Avg_JSD/Avg_WD report over synthesis snapshots"
+    )
+    p.add_argument("--real", required=True, help="real table CSV")
+    p.add_argument("--result-dir", required=True,
+                   help="directory with <name>_synthesis_epoch_<i>.csv files")
+    p.add_argument("--name", required=True, help="run/dataset name prefix")
+    p.add_argument("--categorical", nargs="*", default=[])
+    p.add_argument("--timing", default=None,
+                   help="timestamp_experiment.csv (one wall-clock per round)")
+    p.add_argument("-o", "--out", default=None,
+                   help="output CSV (default <result-dir>/"
+                        "<name>_statistical_similarity_analysis.csv)")
+    args = p.parse_args(argv)
+
+    pat = re.compile(rf"{re.escape(args.name)}_synthesis_epoch_(\d+)\.csv$")
+    found = []
+    for f in glob.glob(os.path.join(args.result_dir, f"{args.name}_synthesis_epoch_*.csv")):
+        m = pat.search(f)
+        if m:
+            found.append((int(m.group(1)), f))
+    if not found:
+        print(f"no {args.name}_synthesis_epoch_*.csv under {args.result_dir}")
+        return 2
+    found.sort()
+    epochs, paths = zip(*found)
+
+    use_timing = False
+    if args.timing:
+        with open(args.timing) as f:
+            per_round = [float(line.split(",")[0]) for line in f if line.strip()]
+        if per_round:
+            # snapshots may be sparser than rounds (--sample-every); charge
+            # each snapshot the cumulative time up to its round
+            cum = np.cumsum(per_round)
+            cum_at = [cum[min(e, len(cum) - 1)] for e in epochs]
+            use_timing = True
+        else:
+            print(f"note: {args.timing} is empty; omitting time_stamp column")
+
+    df = similarity_report(args.real, list(paths), args.categorical)
+    df["Epoch_No."] = list(epochs)
+    if use_timing:
+        df["time_stamp"] = cum_at
+    out = args.out or os.path.join(
+        args.result_dir, f"{args.name}_statistical_similarity_analysis.csv"
+    )
+    df.to_csv(out, index=False)
+    print(df.to_string(index=False))
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

@@ -97,3 +97,45 @@ def utility_difference(
         "delta_accuracy": float(diff.mean(axis=0)[0]),
         "delta_f1": float(diff.mean(axis=0)[1]),
     }
+
+
+def _main(argv=None) -> int:
+    """Train-on-synthetic/test-on-real utility gap — the reference's
+    ``utility_analysis.py`` workflow (reference Server/utility_analysis.py:
+    94-119) as a module CLI."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        description="ML-utility gap (LR/DT/RF/MLP acc+F1, real minus synthetic)"
+    )
+    p.add_argument("--real-train", required=True)
+    p.add_argument("--real-test", required=True)
+    p.add_argument("--synthetic", required=True)
+    p.add_argument("--target", required=True)
+    p.add_argument("--categorical", nargs="*", default=[])
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    args = p.parse_args(argv)
+
+    train = pd.read_csv(args.real_train)
+    test = pd.read_csv(args.real_test)
+    fake = pd.read_csv(args.synthetic)
+    fake = fake[train.columns.tolist()]
+    res = utility_difference(train, fake, test, args.target, args.categorical)
+    if args.json:
+        print(json.dumps(res))
+        return 0
+    models = ["LR", "DT", "RF", "MLP"]
+    print(f"{'model':<6} {'real acc':>9} {'real F1':>8} {'syn acc':>8} {'syn F1':>7}")
+    for i, m in enumerate(models):
+        ra, rf = res["real"][i]
+        sa, sf = res["synthetic"][i]
+        print(f"{m:<6} {ra:>9.4f} {rf:>8.4f} {sa:>8.4f} {sf:>7.4f}")
+    print(f"delta_accuracy={res['delta_accuracy']:.6f} delta_f1={res['delta_f1']:.6f}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

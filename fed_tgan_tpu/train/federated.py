@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -101,17 +101,25 @@ def build_client_stacks(init: FederatedInit, cfg: TrainConfig, spec: SegmentSpec
 
 
 def make_federated_epoch(
-    spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, k: int
+    spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, k: int,
+    rounds: int = 1,
 ):
-    """Build the jitted one-epoch SPMD program.
+    """Build the jitted SPMD program for ``rounds`` federated rounds.
 
     Arguments of the returned function (all with leading n_clients axis,
     sharded over 'clients', except ``key`` which is replicated):
     models, data, cond, rows, steps, weights, key.
+
+    Returns (models, metrics, next_key).  ``key`` is consumed like the host
+    loop does — one ``jax.random.split`` per round, on device — so running
+    one rounds=N program is BIT-IDENTICAL to N sequential rounds=1 calls
+    (fusing rounds between snapshots removes N-1 host round trips without
+    changing the training trajectory).  ``metrics`` gain a leading rounds
+    axis.
     """
     step = make_train_step(spec, cfg)
 
-    def epoch_local(models, data, cond, rows, steps_i, weight, key):
+    def one_round(models, data, cond, rows, steps_i, key):
         # local blocks carry leading axis k (participants on this device)
         rank = jax.lax.axis_index(CLIENTS_AXIS)
 
@@ -142,25 +150,36 @@ def make_federated_epoch(
             )
             return models_i, metrics
 
-        models, metrics = jax.vmap(run_one)(
-            models, data, cond, rows, steps_i, jnp.arange(k)
-        )
+        return jax.vmap(run_one)(models, data, cond, rows, steps_i, jnp.arange(k))
 
-        # ---- the entire Fed-TGAN communication round: one weighted psum ----
+    def epoch_local(models, data, cond, rows, steps_i, weight, key):
         avg = partial(weighted_average, weights=weight)
-        models = models._replace(
-            params_g=replicate_local(avg(models.params_g), k),
-            params_d=replicate_local(avg(models.params_d), k),
-            state_g=replicate_local(avg(models.state_g), k),
+
+        def round_body(carry, _):
+            models_c, chain = carry
+            # same split protocol the host loop used, now on device
+            chain, rkey = jax.random.split(chain)
+            models_c, metrics = one_round(models_c, data, cond, rows, steps_i, rkey)
+            # ---- the entire Fed-TGAN communication round: one weighted psum
+            models_c = models_c._replace(
+                params_g=replicate_local(avg(models_c.params_g), k),
+                params_d=replicate_local(avg(models_c.params_d), k),
+                state_g=replicate_local(avg(models_c.state_g), k),
+            )
+            return (models_c, chain), metrics
+
+        (models, key), metrics = jax.lax.scan(
+            round_body, (models, key), None, length=rounds
         )
-        return models, metrics
+        return models, metrics, key
 
     sharded = P(CLIENTS_AXIS)
     fn = jax.shard_map(
         epoch_local,
         mesh=mesh,
         in_specs=(sharded, sharded, sharded, sharded, sharded, sharded, P()),
-        out_specs=(sharded, sharded),
+        # metrics carry a leading rounds axis; the key chain is replicated
+        out_specs=(sharded, P(None, CLIENTS_AXIS), P()),
         # the fused Pallas activation can't declare per-axis varying-ness on
         # its out_shape; its outputs are strictly per-client row blocks
         check_vma=False,
@@ -271,9 +290,7 @@ class FederatedTrainer(RoundBookkeeping):
             one,
         )
 
-        self._epoch_fn = make_federated_epoch(
-            self.spec, self.cfg, self.max_steps, self.mesh, self.k
-        )
+        self._epoch_fns: dict[int, Any] = {}
         from fed_tgan_tpu.ops.decode import make_device_decode
 
         self._encoded_cache = SampleProgramCache(self.spec, self.cfg)
@@ -293,10 +310,28 @@ class FederatedTrainer(RoundBookkeeping):
         spec = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         return jax.device_put(tree, spec)
 
-    def fit(self, epochs: int, log_every: int = 0, sample_hook=None):
+    def _epoch_fn_for(self, rounds: int):
+        if rounds not in self._epoch_fns:
+            self._epoch_fns[rounds] = make_federated_epoch(
+                self.spec, self.cfg, self.max_steps, self.mesh, self.k,
+                rounds=rounds,
+            )
+        return self._epoch_fns[rounds]
+
+    def fit(self, epochs: int, log_every: int = 0, sample_hook=None,
+            hook_epochs=None, max_rounds_per_call: int = 16):
         """Run ``epochs`` federated rounds; optionally call
         ``sample_hook(epoch, self)`` after each (the reference snapshots a
-        40k-row synthetic CSV per epoch, distributed.py:820)."""
+        40k-row synthetic CSV per epoch, distributed.py:820).
+
+        Rounds with no hook due are FUSED into one device program (the key
+        chain advances on device, so a fused stretch is bit-identical to
+        sequential rounds).  ``hook_epochs`` restricts which rounds the hook
+        fires on — pass the sparse snapshot/checkpoint schedule so the
+        stretches in between collapse to single host round trips, up to
+        ``max_rounds_per_call`` rounds each (bounds compile time and how much
+        wall-clock one call can hold).
+        """
         models = self._shard(self.models)
         data = self._shard(jnp.asarray(self.data_stack))
         cond = self._shard(self.cond_stack)
@@ -304,24 +339,40 @@ class FederatedTrainer(RoundBookkeeping):
         steps = self._shard(jnp.asarray(self.steps))
         weights = self._shard(jnp.asarray(self.weights))
 
-        for _ in range(epochs):
-            e = self.completed_epochs  # global round index (survives resume)
+        e = self.completed_epochs  # global round index (survives resume)
+        end = e + epochs
+        if sample_hook is None:
+            firing = set()
+        elif hook_epochs is None:
+            firing = set(range(e, end))
+        else:
+            firing = {x for x in hook_epochs if e <= x < end}
+
+        while e < end:
+            nxt = min((f for f in firing if f >= e), default=end - 1)
+            size = min(nxt - e + 1, max_rounds_per_call, end - e)
             t0 = time.time()
-            self._key, ekey = jax.random.split(self._key)
-            models, metrics = self._epoch_fn(
-                models, data, cond, rows, steps, weights, ekey
+            models, metrics, self._key = self._epoch_fn_for(size)(
+                models, data, cond, rows, steps, weights, self._key
             )
             # epoch_times feeds timestamp_experiment.csv — must measure the
-            # round's real wall-clock, not async dispatch latency
+            # chunk's real wall-clock, not async dispatch latency
             jax.block_until_ready(models)
             self.models = models
-            self._finish_round(time.time() - t0, e, sample_hook)
-            if log_every and (e % log_every == 0):
+            per_round = (time.time() - t0) / size
+            last = e + size - 1
+            for ei in range(e, e + size):
+                self._finish_round(
+                    per_round, ei,
+                    sample_hook if (ei == last and ei in firing) else None,
+                )
+            if log_every and any(ei % log_every == 0 for ei in range(e, e + size)):
                 m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
                 print(
-                    f"round {e}: loss_d={m['loss_d']:.3f} pen={m['pen']:.3f} "
-                    f"loss_g={m['loss_g']:.3f} ({self.epoch_times[-1]:.3f}s)"
+                    f"round {last}: loss_d={m['loss_d']:.3f} pen={m['pen']:.3f} "
+                    f"loss_g={m['loss_g']:.3f} ({self.epoch_times[-1]:.3f}s/round)"
                 )
+            e += size
         jax.block_until_ready(models)
         self.models = models
         return self

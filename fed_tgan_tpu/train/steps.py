@@ -240,12 +240,13 @@ class SampleProgramCache:
 
     Large requests run as host-chunked device programs of at most
     ``max_chunk_steps`` batches (bounding the on-device result buffer); the
-    tail chunk is bucketed to the next power of two so the number of distinct
-    compiled programs is O(log max_chunk_steps), not O(#distinct sizes).
+    tail chunk is bucketed up to a multiple of 16 steps, so the number of
+    distinct compiled programs stays <= max_chunk_steps/16 while over-compute
+    from padding is < 16 batches per request.
     """
 
     def __init__(self, spec: SegmentSpec, cfg: TrainConfig, decode_fn=None,
-                 max_chunk_steps: int = 64):
+                 max_chunk_steps: int = 128):
         self.spec = spec
         self.cfg = cfg
         self.decode_fn = decode_fn
@@ -263,16 +264,20 @@ class SampleProgramCache:
         import numpy as np
 
         total_steps = -(-n // self.cfg.batch_size)
-        out, start = [], 0
+        out, pending, start = [], [], 0
         while start < total_steps:
             remaining = total_steps - start
             if remaining >= self.max_chunk_steps:
                 steps = self.max_chunk_steps
             else:
-                steps = 1 << (remaining - 1).bit_length()  # next power of two
-                steps = min(steps, self.max_chunk_steps)
-            out.append(
-                np.asarray(self._program(steps)(params_g, state_g, cond, key, start))
-            )
+                steps = min(-(-remaining // 16) * 16, self.max_chunk_steps)
+            # double-buffered: dispatch is async so chunk i+1 runs on device
+            # while chunk i transfers to host, but at most 2 chunk buffers
+            # are ever live — generation stays memory-bounded no matter how
+            # large the request
+            pending.append(self._program(steps)(params_g, state_g, cond, key, start))
+            if len(pending) == 2:
+                out.append(np.asarray(pending.pop(0)))
             start += steps
+        out.extend(np.asarray(p) for p in pending)
         return np.concatenate(out, axis=0)[:n]

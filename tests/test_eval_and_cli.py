@@ -203,3 +203,50 @@ def test_cli_standalone_mode(tmp_path, toy_frame):
 
     loaded = load_synthesizer(str(tmp_path / "models" / "synthesizer"))
     assert loaded.sample_encoded(16, seed=1).shape[0] == 16
+
+
+def test_similarity_module_cli(tmp_path, toy_frame):
+    """The reference's similarity_analysis.py workflow as a module CLI
+    (reference Server/similarity_analysis.py:88-118)."""
+    from fed_tgan_tpu.eval.similarity import _main as sim_main
+
+    real_p = tmp_path / "real.csv"
+    toy_frame.to_csv(real_p, index=False)
+    rdir = tmp_path / "toy_result"
+    rdir.mkdir()
+    # sparse snapshots: epochs 0 and 2 only (as with --sample-every 2)
+    for e in (0, 2):
+        toy_frame.sample(frac=1.0, random_state=e).to_csv(
+            rdir / f"toy_synthesis_epoch_{e}.csv", index=False
+        )
+    (tmp_path / "timestamp_experiment.csv").write_text("1.0\n2.0\n3.0\n")
+    rc = sim_main([
+        "--real", str(real_p), "--result-dir", str(rdir), "--name", "toy",
+        "--categorical", "color", "flag",
+        "--timing", str(tmp_path / "timestamp_experiment.csv"),
+    ])
+    assert rc == 0
+    out = pd.read_csv(rdir / "toy_statistical_similarity_analysis.csv")
+    assert out["Epoch_No."].tolist() == [0, 2]
+    # cumulative wall-clock charged up to each snapshot's round
+    assert out["time_stamp"].tolist() == [1.0, 6.0]
+    assert (out["Avg_JSD"] < 1e-9).all()  # same rows, shuffled
+
+
+def test_utility_module_cli(tmp_path, toy_frame, capsys):
+    from fed_tgan_tpu.eval.utility import _main as util_main
+
+    train_p, test_p, syn_p = (tmp_path / n for n in ("tr.csv", "te.csv", "syn.csv"))
+    toy_frame.iloc[:400].to_csv(train_p, index=False)
+    toy_frame.iloc[400:].to_csv(test_p, index=False)
+    toy_frame.iloc[:400].to_csv(syn_p, index=False)  # synthetic == real train
+    rc = util_main([
+        "--real-train", str(train_p), "--real-test", str(test_p),
+        "--synthetic", str(syn_p), "--target", "flag",
+        "--categorical", "color", "flag", "--json",
+    ])
+    assert rc == 0
+    import json
+
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert abs(res["delta_f1"]) < 1e-9 and len(res["real"]) == 4

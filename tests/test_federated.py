@@ -115,3 +115,36 @@ def test_timing_instrumentation(fed_init, tmp_path):
     phases = (tmp_path / "timing_phases.csv").read_text().strip().splitlines()
     assert phases[0].startswith("epoch,train_aggregate_s,distribution_s,total_s")
     assert len(phases) == 3
+
+
+def test_fused_rounds_bit_identical_to_sequential(fed_init):
+    """rounds=N fusion must not change the training trajectory: the on-device
+    key chain replays the host split protocol exactly."""
+    mesh = client_mesh(4)
+    fused = FederatedTrainer(fed_init, config=CFG, mesh=mesh, seed=7)
+    seq = FederatedTrainer(fed_init, config=CFG, mesh=mesh, seed=7)
+    fused.fit(epochs=3)  # no hook -> one 3-round program
+    seq.fit(epochs=3, max_rounds_per_call=1)
+    assert len(fused._epoch_fns) == 1 and 3 in fused._epoch_fns
+    assert len(seq._epoch_fns) == 1 and 1 in seq._epoch_fns
+    for a, b in zip(jax.tree.leaves(fused.models), jax.tree.leaves(seq.models)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        jax.random.key_data(fused._key), jax.random.key_data(seq._key)
+    )
+    assert fused.completed_epochs == seq.completed_epochs == 3
+
+
+def test_sparse_hook_epochs_fuse_and_fire(fed_init):
+    mesh = client_mesh(4)
+    tr = FederatedTrainer(fed_init, config=CFG, mesh=mesh, seed=1)
+    fired = []
+    tr.fit(epochs=5, sample_hook=lambda e, t: fired.append(e), hook_epochs=[0, 3])
+    assert fired == [0, 3]
+    assert tr.completed_epochs == 5
+    assert len(tr.epoch_times) == 5
+    # chunks: [0], [1..3], [4] -> programs for sizes 1 and 3
+    assert set(tr._epoch_fns) == {1, 3}
+    # hook time lands on the firing rounds only
+    assert tr.phase_times["distribution"][1] == 0.0
+    assert tr.phase_times["distribution"][4] == 0.0
