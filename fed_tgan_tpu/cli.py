@@ -27,6 +27,8 @@ import pickle
 import sys
 import time
 
+from fed_tgan_tpu.data.encoders import encoder_artifact
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fed_tgan_tpu", description=__doc__)
@@ -36,8 +38,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-client CSVs (true federated layout); overrides --datapath sharding")
     p.add_argument("--dataset", type=str, default="intrusion",
                    help="schema preset: intrusion|adult|covertype|custom")
+    p.add_argument("-selected_variables", "--selected", type=str, nargs="*",
+                   default=None, help="columns to synthesize (reference "
+                   "-selected_variables); default: preset list or all columns")
     p.add_argument("--categorical", type=str, nargs="*", default=None)
     p.add_argument("--non-negative", type=str, nargs="*", default=None)
+    p.add_argument("--date-format", type=str, nargs="*", default=None,
+                   help="date columns as col=FORMAT (e.g. when=YYYY-MM-DD); "
+                        "the reference CLI's -date_dic")
     p.add_argument("--target-column", type=str, default=None)
     p.add_argument("--problem-type", type=str, default=None)
     p.add_argument("-epochs", "--epochs", type=int, default=10)
@@ -80,14 +88,125 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _dataset_kwargs(args):
+    """(run name, TablePreprocessor kwargs) from the preset/flag combination;
+    (None, None) on an unknown preset."""
+    from fed_tgan_tpu.datasets import PRESETS, preprocessor_kwargs
+
+    if args.dataset != "custom" and args.dataset not in PRESETS:
+        print(f"unknown dataset preset {args.dataset!r}; use {sorted(PRESETS)} or 'custom'")
+        return None, None
+
+    if args.dataset == "custom":
+        kwargs = dict(
+            categorical_columns=args.categorical or [],
+            non_negative_columns=args.non_negative or [],
+            date_formats=_parse_date_formats(args.date_format),
+            target_column=args.target_column or "",
+            problem_type=args.problem_type or "",
+            selected_columns=args.selected or None,
+        )
+        # the multihost server (rank 0) may legitimately have no datapath
+        name = (
+            os.path.basename(args.datapath).rsplit(".", 1)[0]
+            if args.datapath else "custom"
+        )
+    else:
+        preset = PRESETS[args.dataset]
+        kwargs = preprocessor_kwargs(preset)
+        for flag, kw in [
+            ("categorical", "categorical_columns"),
+            ("non_negative", "non_negative_columns"),
+            ("target_column", "target_column"),
+            ("problem_type", "problem_type"),
+        ]:
+            v = getattr(args, flag)
+            if v is not None:
+                kwargs[kw] = v
+        if args.selected:  # bare --selected (empty list) means "all columns"
+            kwargs["selected_columns"] = args.selected
+        if args.date_format is not None:
+            kwargs["date_formats"] = _parse_date_formats(args.date_format)
+        name = preset.name
+    return name, kwargs
+
+
+def _run_multihost_init(args) -> int:
+    """Reference-style multi-process launch (reference run(),
+    Server/dtds/distributed.py:838-891): rank 0 drives the init protocol,
+    ranks 1..N participate over the native TCP transport.  Produces the same
+    global artifacts as the in-process ``federated_initialize``; training
+    then runs as SPMD mesh slices (``jax.distributed``), not over RPC."""
+    import pickle
+
+    import pandas as pd
+
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.federation.distributed import (
+        client_initialize,
+        server_initialize,
+    )
+    from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
+
+    name, kwargs = _dataset_kwargs(args)
+    if name is None:
+        return 2
+    port = args.port or 7788  # reference default port (distributed.py:898)
+    if args.rank == 0:
+        os.makedirs(os.path.join(args.out_dir, "models"), exist_ok=True)
+        with ServerTransport(port, args.world_size - 1) as t:
+            out = server_initialize(t, seed=args.seed, weighted=not args.uniform)
+        out["global_meta"].dump_json(os.path.join(args.out_dir, "models", f"{name}.json"))
+        with open(
+            os.path.join(args.out_dir, "models", f"label_encoders_{name}.pickle"), "wb"
+        ) as f:
+            pickle.dump(
+                encoder_artifact(
+                    out["global_meta"].categorical_columns, out["encoders"]
+                ),
+                f,
+            )
+        print(
+            f"multihost init complete: {args.world_size - 1} clients, "
+            f"weights={[round(float(w), 4) for w in out['weights']]}"
+        )
+    else:
+        pre = TablePreprocessor(frame=pd.read_csv(args.datapath), name=name, **kwargs)
+        with ClientTransport(args.ip, port, args.rank) as t:
+            out = client_initialize(t, pre, seed=args.seed)
+        print(
+            f"rank {args.rank} init complete: {out['matrix'].shape[0]} rows x "
+            f"{out['matrix'].shape[1]} encoded dims; ready to join the mesh"
+        )
+    return 0
+
+
+def _parse_date_formats(items) -> dict:
+    """['when=YYYY-MM-DD', ...] -> {'when': 'YYYY-MM-DD'} (the reference
+    passes the same mapping as its -date_dic argument)."""
+    out = {}
+    for item in items or []:
+        col, sep, fmt = item.partition("=")
+        if not sep or not col or not fmt:
+            raise SystemExit(f"--date-format entries must be col=FORMAT, got {item!r}")
+        out[col] = fmt
+    return out
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    if args.rank is not None and args.world_size and args.ip:
+        # reference-style multi-process launch (rank 0 = server, 1..N =
+        # clients): runs the federated INIT protocol over the native
+        # transport; training itself is one SPMD program per mesh slice
+        return _run_multihost_init(args)
     if args.rank is not None and args.rank != 0:
         print(
             "fed_tgan_tpu runs all participants inside one SPMD program; "
             f"rank {args.rank} has no separate process to start. Launch only "
-            "rank 0 (or omit -rank)."
+            "rank 0 (or omit -rank), or pass -ip/-world_size for the "
+            "multi-host init protocol."
         )
         return 0
 
@@ -112,31 +231,9 @@ def main(argv=None) -> int:
     from fed_tgan_tpu.train.federated import FederatedTrainer
     from fed_tgan_tpu.train.steps import TrainConfig
 
-    if args.dataset != "custom" and args.dataset not in PRESETS:
-        print(f"unknown dataset preset {args.dataset!r}; use {sorted(PRESETS)} or 'custom'")
+    name, kwargs = _dataset_kwargs(args)
+    if name is None:
         return 2
-
-    if args.dataset == "custom":
-        kwargs = dict(
-            categorical_columns=args.categorical or [],
-            non_negative_columns=args.non_negative or [],
-            target_column=args.target_column or "",
-            problem_type=args.problem_type or "",
-        )
-        name = os.path.basename(args.datapath).rsplit(".", 1)[0]
-    else:
-        preset = PRESETS[args.dataset]
-        kwargs = preprocessor_kwargs(preset)
-        for flag, kw in [
-            ("categorical", "categorical_columns"),
-            ("non_negative", "non_negative_columns"),
-            ("target_column", "target_column"),
-            ("problem_type", "problem_type"),
-        ]:
-            v = getattr(args, flag)
-            if v is not None:
-                kwargs[kw] = v
-        name = preset.name
 
     n_clients = args.n_clients
     if n_clients is None:
@@ -288,11 +385,7 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     init.global_meta.dump_json(os.path.join(models_dir, f"{name}.json"))
     with open(os.path.join(models_dir, f"label_encoders_{name}.pickle"), "wb") as f:
         pickle.dump(
-            [
-                {"column_name": c, "label_encoder": e}
-                for c, e in zip(init.global_meta.categorical_columns, init.encoders)
-            ],
-            f,
+            encoder_artifact(init.global_meta.categorical_columns, init.encoders), f
         )
 
     def snapshot(epoch: int, tr) -> None:

@@ -51,3 +51,13 @@ class CategoryEncoder:
     @classmethod
     def from_dict(cls, d: dict) -> "CategoryEncoder":
         return cls(classes_=np.asarray(d["classes"], dtype=object))
+
+
+def encoder_artifact(column_names, encoders) -> list[dict]:
+    """The on-disk label-encoder layout every writer shares:
+    ``[{"column_name": c, "label_encoder": e}, ...]`` (the reference pickles
+    the same shape, Server/dtds/distributed.py:679-681)."""
+    return [
+        {"column_name": c, "label_encoder": e}
+        for c, e in zip(column_names, encoders)
+    ]

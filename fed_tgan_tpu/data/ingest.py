@@ -10,6 +10,7 @@ encoders exist, a dense numpy matrix ready for the feature transformer.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -164,3 +165,49 @@ class TablePreprocessor:
     def global_table_meta(self, harmonized_meta: dict) -> TableMeta:
         """Wrap a server-harmonized meta dict into a ``TableMeta``."""
         return TableMeta.from_json_dict(harmonized_meta)
+
+    def write_artifacts(
+        self,
+        encoders: Sequence[CategoryEncoder],
+        meta: dict,
+        out_dir: str,
+        timestamp: Optional[str] = None,
+    ) -> str:
+        """Persist the encoded-dataset artifact trio to disk.
+
+        Equivalent of reference ``FileGenerator.generate_data`` +
+        ``save_synthesizer_model_and_label_encoders``
+        (file_generator.py:156-189, :249-265): one directory
+        ``<out_dir>/<name>-<timestamp>/`` holding the meta JSON, the encoded
+        matrix as ``.npz`` (key ``train``; empty ``test``, matching the
+        ratio=1 reference behavior) and ``.csv``, plus the fitted label
+        encoders pickled next to them.  Returns the directory path.
+        """
+        import json
+        import pickle
+        import time as _time
+
+        if timestamp is None:
+            timestamp = str(_time.time()).replace(".", "")
+        run = f"{self.name}-{timestamp}"
+        path = os.path.join(out_dir, run)
+        os.makedirs(path, exist_ok=True)
+
+        with open(os.path.join(path, f"{run}.json"), "w") as f:
+            json.dump(meta, f, sort_keys=True, indent=4, separators=(",", ": "))
+
+        matrix, _, _ = self.encode(encoders)
+        np.savez(
+            os.path.join(path, f"{run}.npz"),
+            train=matrix,
+            test=matrix[:0],
+        )
+        pd.DataFrame(matrix, columns=self.df.columns.tolist()).to_csv(
+            os.path.join(path, f"{run}.csv"), index=False
+        )
+        from fed_tgan_tpu.data.encoders import encoder_artifact
+
+        cat_cols = [c for c in self.df.columns if c in self.categorical_columns]
+        with open(os.path.join(path, f"label_encoders_{self.name}.pickle"), "wb") as f:
+            pickle.dump(encoder_artifact(cat_cols, encoders), f)
+        return path

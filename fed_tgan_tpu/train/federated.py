@@ -217,6 +217,33 @@ class RoundBookkeeping:
             self.phase_times["distribution"][-1] = t_hook
             self.epoch_times[-1] = t_round + t_hook
 
+    def _check_finite(self, metrics, first_epoch: int, mode: str) -> None:
+        """Divergence detection (the reference has none, SURVEY §5.3): flags
+        non-finite losses (WGAN-GP blow-ups) right after the device program
+        returns, naming the first bad round so a checkpointed run can be
+        resumed from before it.  ``mode``: 'ignore' | 'warn' | 'raise'."""
+        if mode == "ignore":
+            return
+        # earliest bad round across ALL metrics — divergence usually shows in
+        # one loss first, and that round is what a resume should predate
+        bad = None
+        for name, leaf in metrics.items():
+            arr = np.asarray(leaf)
+            finite = np.isfinite(arr).reshape(arr.shape[0], -1).all(axis=1)
+            if not finite.all():
+                r = first_epoch + int(np.argmin(finite))
+                if bad is None or r < bad[1]:
+                    bad = (name, r)
+        if bad is None:
+            return
+        msg = (
+            f"non-finite {bad[0]} at round {bad[1]}: training has diverged "
+            f"(resume from an earlier checkpoint or lower the learning rate)"
+        )
+        if mode == "raise":
+            raise FloatingPointError(msg)
+        print(f"WARNING: {msg}")
+
     def write_timing(self, out_dir: str = ".") -> None:
         """``timestamp_experiment.csv`` — one wall-clock value per round
         (reference distributed.py:827-829, excel dialect, single column) —
@@ -319,7 +346,8 @@ class FederatedTrainer(RoundBookkeeping):
         return self._epoch_fns[rounds]
 
     def fit(self, epochs: int, log_every: int = 0, sample_hook=None,
-            hook_epochs=None, max_rounds_per_call: int = 16):
+            hook_epochs=None, max_rounds_per_call: int = 16,
+            on_nonfinite: str = "warn"):
         """Run ``epochs`` federated rounds; optionally call
         ``sample_hook(epoch, self)`` after each (the reference snapshots a
         40k-row synthetic CSV per epoch, distributed.py:820).
@@ -359,6 +387,7 @@ class FederatedTrainer(RoundBookkeeping):
             # chunk's real wall-clock, not async dispatch latency
             jax.block_until_ready(models)
             self.models = models
+            self._check_finite(metrics, e, on_nonfinite)
             per_round = (time.time() - t0) / size
             last = e + size - 1
             for ei in range(e, e + size):

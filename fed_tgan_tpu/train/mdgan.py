@@ -274,7 +274,8 @@ class MDGANTrainer(RoundBookkeeping):
         # so --mode mdgan numbers are comparable with fedavg runs
         self._init_bookkeeping()
 
-    def fit(self, epochs: int, log_every: int = 0, sample_hook=None):
+    def fit(self, epochs: int, log_every: int = 0, sample_hook=None,
+            on_nonfinite: str = "warn"):
         shard = lambda t: jax.device_put(
             t, NamedSharding(self.mesh, P(CLIENTS_AXIS))
         )
@@ -293,6 +294,9 @@ class MDGANTrainer(RoundBookkeeping):
             jax.block_until_ready(gen)
             self.gen, self.disc = gen, disc
             e = self.completed_epochs
+            self._check_finite(
+                jax.tree.map(lambda x: np.asarray(x)[None], metrics), e, on_nonfinite
+            )
             self._finish_round(time.time() - t0, e, sample_hook)
             if log_every and e % log_every == 0:
                 m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)

@@ -141,3 +141,37 @@ def test_sharding_strategies(toy_frame):
 
     dfs = shard_dataframe(toy_frame, 4, "dirichlet", label_column="flag", alpha=0.1, seed=3)
     assert sum(len(d) for d in dfs) == len(toy_frame)
+
+
+def test_write_artifacts_trio(tmp_path, toy_frame):
+    """Reference FileGenerator.generate_data artifact layout: meta json +
+    npz (train/test) + encoded csv + pickled encoders in one run directory
+    (reference Server/dtds/data/utils/file_generator.py:156-189,249-265)."""
+    import json
+    import pickle
+
+    import numpy as np
+
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.federation.init import harmonize_categories
+
+    pre = TablePreprocessor(
+        frame=toy_frame, name="toy", categorical_columns=["color", "flag"]
+    )
+    meta, encoders, _ = harmonize_categories([pre.local_meta()])
+    path = pre.write_artifacts(encoders, meta, str(tmp_path), timestamp="123")
+    assert path.endswith("toy-123")
+    with open(f"{path}/toy-123.json") as f:
+        assert json.load(f)["name"] == "toy"
+    with np.load(f"{path}/toy-123.npz") as z:
+        assert z["train"].shape == (len(toy_frame), 4)
+        assert z["test"].shape[0] == 0
+    import pandas as pd
+
+    csv = pd.read_csv(f"{path}/toy-123.csv")
+    assert csv.shape == (len(toy_frame), 4)
+    with open(f"{path}/label_encoders_toy.pickle", "rb") as f:
+        les = pickle.load(f)
+    assert [d["column_name"] for d in les] == ["color", "flag"]
+    # encoded categorical columns are integer codes consistent with encoders
+    assert set(np.unique(csv["color"])) <= set(range(3))

@@ -148,3 +148,24 @@ def test_sparse_hook_epochs_fuse_and_fire(fed_init):
     # hook time lands on the firing rounds only
     assert tr.phase_times["distribution"][1] == 0.0
     assert tr.phase_times["distribution"][4] == 0.0
+
+
+def test_nonfinite_guard(fed_init, capsys):
+    mesh = client_mesh(4)
+    tr = FederatedTrainer(fed_init, config=CFG, mesh=mesh, seed=0)
+    # healthy run: no warning
+    tr.fit(epochs=1)
+    assert "non-finite" not in capsys.readouterr().out
+    # synthetic divergence detection on doctored metrics
+    bad = {
+        "loss_d": np.array([[0.1, 0.2], [np.nan, 0.3]], dtype=np.float32),
+        "pen": np.zeros((2, 2), np.float32),
+        "loss_g": np.zeros((2, 2), np.float32),
+    }
+    tr._check_finite(bad, first_epoch=10, mode="warn")
+    out = capsys.readouterr().out
+    assert "non-finite loss_d at round 11" in out
+    import pytest as _pytest
+
+    with _pytest.raises(FloatingPointError):
+        tr._check_finite(bad, first_epoch=10, mode="raise")

@@ -1,6 +1,8 @@
 """Native transport + distributed init protocol over localhost."""
 
+import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -98,3 +100,59 @@ def test_distributed_init_matches_in_process(toy_frame, toy_spec):
     for rank in (1, 2):
         assert client_out[rank]["matrix"].shape[1] == reference.client_matrices[0].shape[1]
         assert client_out[rank]["transformer"].output_info == reference.output_info
+
+
+def test_cli_multihost_init_processes(tmp_path):
+    """Reference-style launch: rank 0 + two client ranks as separate
+    PROCESSES over TCP (reference README.md:10-13), via the CLI."""
+    import subprocess
+    import sys
+
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(0)
+    n = 120
+    df = pd.DataFrame({
+        "amount": rng.normal(10, 3, n),
+        "color": rng.choice(["red", "green", "blue"], n),
+        "flag": rng.choice(["y", "n"], n),
+    })
+    shards = [df.iloc[:60], df.iloc[60:]]
+    paths = []
+    for i, s in enumerate(shards):
+        p = tmp_path / f"shard{i}.csv"
+        s.to_csv(p, index=False)
+        paths.append(str(p))
+
+    port = 18000 + os.getpid() % 2000  # avoid cross-run collisions
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    base = [
+        sys.executable, "-m", "fed_tgan_tpu.cli",
+        "--dataset", "custom", "--categorical", "color", "flag",
+        "-world_size", "3", "-ip", "127.0.0.1", "-port", str(port),
+        "--out-dir", str(tmp_path),
+    ]
+    server = subprocess.Popen(
+        base + ["-rank", "0", "--datapath", paths[0]],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd="/root/repo",
+    )
+    time.sleep(1.0)
+    clients = [
+        subprocess.Popen(
+            base + ["-rank", str(r), "--datapath", paths[r - 1]],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for r in (1, 2)
+    ]
+    out_s, _ = server.communicate(timeout=180)
+    outs_c = [c.communicate(timeout=180)[0] for c in clients]
+    assert server.returncode == 0, out_s[-2000:]
+    assert "multihost init complete: 2 clients" in out_s
+    for r, oc in zip((1, 2), outs_c):
+        assert f"rank {r} init complete" in oc, oc[-2000:]
+    assert (tmp_path / "models" / "shard0.json").exists()
+    assert (tmp_path / "models" / "label_encoders_shard0.pickle").exists()
