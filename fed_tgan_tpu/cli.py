@@ -6,9 +6,14 @@ The SPMD redesign needs ONE launch: participants live on mesh positions, so
 ``--n-clients 8`` replaces world_size bookkeeping, and ``--backend`` selects
 tpu (default: whatever jax finds) or a cpu mesh with virtual devices.
 
-Reference-style ``-rank``/``-world_size`` flags are accepted for drop-in
-compatibility: rank != 0 exits immediately (there are no client processes to
-start), world_size maps to n-clients = world_size - 1.
+Reference-style ``-rank``/``-world_size``/``-ip``/``-port`` flags are
+accepted for drop-in compatibility.  Passing rank AND ip AND world_size
+launches the reference's multi-process model: rank 0 binds the native TCP
+transport and BLOCKS until world_size-1 client ranks join (exactly like the
+reference's ``rpc.init_rpc`` rendezvous), then runs the federated init
+protocol.  Without ``-ip``, rank 0 (or no rank) runs the single-program SPMD
+path where world_size maps to n-clients = world_size - 1, and rank != 0
+exits immediately (there are no client processes to start).
 
 Outputs mirror the reference layout so similarity_analysis.py /
 utility_analysis.py work unchanged:
@@ -106,11 +111,9 @@ def _dataset_kwargs(args):
             problem_type=args.problem_type or "",
             selected_columns=args.selected or None,
         )
-        # the multihost server (rank 0) may legitimately have no datapath
-        name = (
-            os.path.basename(args.datapath).rsplit(".", 1)[0]
-            if args.datapath else "custom"
-        )
+        # -datapath always has the reference's default, so a name is always
+        # derivable; the multihost server (rank 0) never reads the file
+        name = os.path.basename(args.datapath).rsplit(".", 1)[0]
     else:
         preset = PRESETS[args.dataset]
         kwargs = preprocessor_kwargs(preset)
@@ -123,8 +126,9 @@ def _dataset_kwargs(args):
             v = getattr(args, flag)
             if v is not None:
                 kwargs[kw] = v
-        if args.selected:  # bare --selected (empty list) means "all columns"
-            kwargs["selected_columns"] = args.selected
+        if args.selected is not None:
+            # bare --selected (empty list) means "all columns" (None)
+            kwargs["selected_columns"] = args.selected or None
         if args.date_format is not None:
             kwargs["date_formats"] = _parse_date_formats(args.date_format)
         name = preset.name
@@ -137,8 +141,6 @@ def _run_multihost_init(args) -> int:
     ranks 1..N participate over the native TCP transport.  Produces the same
     global artifacts as the in-process ``federated_initialize``; training
     then runs as SPMD mesh slices (``jax.distributed``), not over RPC."""
-    import pickle
-
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor

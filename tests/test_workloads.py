@@ -1,0 +1,114 @@
+"""BASELINE.md target-config workloads (4 and 5) at test scale:
+Adult-style mixed table with non-IID label shards on 8 clients, and a
+Covertype-style multiclass table with 32 clients stacked 4-per-device on the
+8-device mesh, weighted aggregation + ML-utility eval."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.parallel.mesh import client_mesh
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+
+CFG = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16), batch_size=24, pac=4)
+
+
+def _adult_like(n=2400, seed=0):
+    rng = np.random.default_rng(seed)
+    work = rng.choice(["private", "gov", "self"], n, p=[0.7, 0.2, 0.1])
+    edu = rng.choice(["hs", "college", "masters"], n)
+    income = np.where(
+        (edu == "masters") | (rng.random(n) < 0.2), ">50K", "<=50K"
+    )
+    return pd.DataFrame({
+        "age": rng.integers(17, 90, n).astype(float),
+        "workclass": work,
+        "education": edu,
+        "hours": rng.normal(40, 10, n),
+        "capital-gain": np.abs(rng.lognormal(1, 2, n)),  # non-negative, skewed
+        "income": income,
+    })
+
+
+def _covertype_like(n=2000, seed=1):
+    rng = np.random.default_rng(seed)
+    cover = rng.integers(1, 8, n)  # 7 classes
+    return pd.DataFrame({
+        "Elevation": rng.normal(2800, 300, n) + cover * 10,
+        "Slope": np.abs(rng.normal(12, 6, n)),
+        "Hillshade": rng.integers(0, 255, n).astype(float),
+        "Cover_Type": cover.astype(str),
+    })
+
+
+def test_adult_noniid_dirichlet_8clients():
+    df = _adult_like()
+    frames = shard_dataframe(
+        df, 8, "dirichlet", label_column="income", alpha=2.0, seed=3
+    )
+    assert len(frames) == 8 and sum(len(f) for f in frames) == len(df)
+    # dirichlet sharding is genuinely non-IID: label mix varies across shards
+    fracs = [
+        (f["income"] == ">50K").mean() for f in frames if len(f) > 0
+    ]
+    assert max(fracs) - min(fracs) > 0.05
+
+    clients = [
+        TablePreprocessor(
+            frame=f, name="adult",
+            categorical_columns=["workclass", "education", "income"],
+            non_negative_columns=["capital-gain"],
+            target_column="income", problem_type="binary_classification",
+        )
+        for f in frames
+    ]
+    init = federated_initialize(clients, seed=0)
+    # non-IID shards -> similarity weights genuinely differ across clients
+    assert init.weights.std() > 0
+    tr = FederatedTrainer(init, config=CFG, mesh=client_mesh(8), seed=0)
+    tr.fit(epochs=2)
+    out = tr.sample(300, seed=1)
+    assert out.shape == (300, 6)
+    assert np.isfinite(out).all()
+
+    from fed_tgan_tpu.data.decode import decode_matrix
+
+    raw = decode_matrix(out, init.global_meta, init.encoders)
+    assert set(raw["income"].unique()) <= {">50K", "<=50K"}
+    assert (raw["capital-gain"].astype(float) >= 0).all()  # log1p inverse
+
+
+def test_covertype_32clients_4_per_device_with_utility():
+    df = _covertype_like()
+    frames = shard_dataframe(df, 32, "iid", seed=5)
+    clients = [
+        TablePreprocessor(
+            frame=f, name="covertype",
+            categorical_columns=["Cover_Type"],
+            target_column="Cover_Type",
+            problem_type="multiclass_classification",
+        )
+        for f in frames
+    ]
+    init = federated_initialize(clients, seed=0)
+    mesh = client_mesh(8)
+    tr = FederatedTrainer(init, config=CFG, mesh=mesh, seed=0)
+    assert tr.k == 4  # 32 participants stacked 4-per-device
+    tr.fit(epochs=2)
+    out = tr.sample(400, seed=2)
+
+    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.eval.utility import utility_difference
+
+    raw = decode_matrix(out, init.global_meta, init.encoders)
+    assert set(raw["Cover_Type"].astype(str)) <= set(map(str, range(1, 8)))
+    res = utility_difference(
+        df.iloc[:1500], raw, df.iloc[1500:], "Cover_Type", ["Cover_Type"]
+    )
+    # 2 epochs won't match real utility; the protocol must just run and
+    # produce the reference-shaped report
+    assert len(res["real"]) == 4 and np.isfinite(res["delta_f1"])
