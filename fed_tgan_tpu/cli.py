@@ -198,17 +198,22 @@ def _parse_date_formats(items) -> dict:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    if args.rank is not None and args.world_size and args.ip:
+    if args.rank is not None and args.ip and (args.rank > 0 or args.world_size):
         # reference-style multi-process launch (rank 0 = server, 1..N =
         # clients): runs the federated INIT protocol over the native
-        # transport; training itself is one SPMD program per mesh slice
+        # transport; training itself is one SPMD program per mesh slice.
+        # Client ranks need only ip/port/rank; the server also needs
+        # world_size to know how many joins to wait for.
         return _run_multihost_init(args)
+    if args.rank == 0 and args.ip and not args.world_size:
+        print("multihost rank 0 needs -world_size (how many clients to wait for)")
+        return 2
     if args.rank is not None and args.rank != 0:
         print(
             "fed_tgan_tpu runs all participants inside one SPMD program; "
             f"rank {args.rank} has no separate process to start. Launch only "
-            "rank 0 (or omit -rank), or pass -ip/-world_size for the "
-            "multi-host init protocol."
+            "rank 0 (or omit -rank), or pass -ip for the multi-host init "
+            "protocol."
         )
         return 0
 

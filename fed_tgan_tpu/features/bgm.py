@@ -123,3 +123,54 @@ def fit_column_gmm(
         gm.fit(x)
         return ColumnGMM.from_sklearn(gm, eps)
     raise ValueError(f"unknown backend {backend!r}")
+
+
+def resolved_init_workers() -> int:
+    """Worker count for init-time GMM fitting (FED_TGAN_TPU_INIT_WORKERS;
+    default 1 — see ``fit_column_gmms`` for why parallelism is opt-in)."""
+    import os
+
+    return int(os.environ.get("FED_TGAN_TPU_INIT_WORKERS") or 1)
+
+
+def _fit_one(args):
+    x, n_components, eps, backend, seed = args
+    return fit_column_gmm(x, n_components, eps, backend, seed)
+
+
+def fit_column_gmms(
+    columns: "list[np.ndarray]",
+    n_components: int = N_CLUSTERS,
+    eps: float = WEIGHT_EPS,
+    backend: str = "sklearn",
+    seed: Optional[int] = None,
+    max_workers: Optional[int] = None,
+) -> "list[ColumnGMM]":
+    """Fit one DP-BGM per column, in parallel across columns.
+
+    The reference fits its 22 Intrusion columns serially
+    (transformers.py:331-340); each fit here is identical (same estimator,
+    same seed), so pooled results are bit-identical to the serial loop
+    regardless of worker count.  Workers are OPT-IN via
+    ``FED_TGAN_TPU_INIT_WORKERS=N``: single-process parallelism only pays on
+    multi-core hosts, and environments whose site hooks eagerly initialize
+    an accelerator runtime on interpreter start (one-chip tunnels) can't
+    spawn compute workers safely.  In real federated deployments the
+    per-client fits parallelize across hosts via the multihost init protocol
+    (federation/distributed.py) instead.
+    """
+    if max_workers is None:
+        max_workers = resolved_init_workers()
+    jobs = [(np.asarray(c, dtype=np.float64), n_components, eps, backend, seed)
+            for c in columns]
+    if max_workers <= 1 or len(jobs) <= 1:
+        return [_fit_one(j) for j in jobs]
+
+    import concurrent.futures as cf
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    with cf.ProcessPoolExecutor(
+        max_workers=min(max_workers, len(jobs)), mp_context=ctx
+    ) as pool:
+        return list(pool.map(_fit_one, jobs))

@@ -23,7 +23,12 @@ import numpy as np
 
 from fed_tgan_tpu.data.encoders import CategoryEncoder
 from fed_tgan_tpu.data.schema import TableMeta
-from fed_tgan_tpu.features.bgm import N_CLUSTERS, WEIGHT_EPS, ColumnGMM, fit_column_gmm
+from fed_tgan_tpu.features.bgm import (
+    N_CLUSTERS,
+    WEIGHT_EPS,
+    ColumnGMM,
+    fit_column_gmms,
+)
 
 CLIP = 0.99
 SCALE = 4.0  # the reference's (x - mu) / (4 sigma)
@@ -79,19 +84,24 @@ class ModeNormalizer:
         """
         data = np.asarray(data, dtype=np.float64)
         discrete = set(categorical_idx) | set(ordinal_idx)
+        # GMM fits dominate init wall-clock; fit all continuous columns in a
+        # process pool (bit-identical to the serial loop — same estimator,
+        # same seed per column)
+        cont_idx = [j for j in range(data.shape[1]) if j not in discrete]
+        gmms = dict(zip(cont_idx, fit_column_gmms(
+            [data[:, j] for j in cont_idx],
+            self.n_components, self.eps, self.backend, self.seed,
+        )))
         self.columns = []
         for j in range(data.shape[1]):
             name = column_names[j] if column_names is not None else str(j)
-            col = data[:, j]
             if j in discrete:
+                col = data[:, j]
                 values, counts = np.unique(col.astype(np.int64), return_counts=True)
                 order = np.argsort(-counts, kind="stable")
                 self.columns.append(DiscreteColumn(name, values[order]))
             else:
-                gmm = fit_column_gmm(
-                    col, self.n_components, self.eps, self.backend, self.seed
-                )
-                self.columns.append(ContinuousColumn(name, gmm))
+                self.columns.append(ContinuousColumn(name, gmms[j]))
         self._finalize()
         return self
 

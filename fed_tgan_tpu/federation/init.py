@@ -35,7 +35,13 @@ from scipy.stats import wasserstein_distance
 from fed_tgan_tpu.data.encoders import CategoryEncoder
 from fed_tgan_tpu.data.ingest import TablePreprocessor
 from fed_tgan_tpu.data.schema import TableMeta
-from fed_tgan_tpu.features.bgm import N_CLUSTERS, WEIGHT_EPS, ColumnGMM, fit_column_gmm
+from fed_tgan_tpu.features.bgm import (
+    N_CLUSTERS,
+    WEIGHT_EPS,
+    ColumnGMM,
+    fit_column_gmms,
+    resolved_init_workers,
+)
 from fed_tgan_tpu.features.transformer import ModeNormalizer
 
 
@@ -124,6 +130,12 @@ def harmonize_continuous(
     wd = np.zeros((n_clients, len(cont_cols)))
     global_gmms: list[Optional[ColumnGMM]] = [None] * n_cols
 
+    # sampling + WD stay serial (they share one rng stream and are cheap).
+    # Pooled refits go to a process pool only when workers are opted in —
+    # batching every column's pooled sample first would otherwise raise peak
+    # memory from O(rows) to O(cols x rows) for nothing.
+    batch = resolved_init_workers() > 1
+    pooled_cols = []
     for cursor, j in enumerate(cont_cols):
         samples = [
             client_gmms[i][j].sample(int(n_sample * by_number[i]), rng)
@@ -132,9 +144,21 @@ def harmonize_continuous(
         pooled = np.concatenate(samples)
         for i in range(n_clients):
             wd[i, cursor] = wasserstein_distance(pooled, samples[i])
-        global_gmms[j] = fit_column_gmm(
-            pooled, n_components=n_components, eps=eps, backend=backend, seed=seed
+        if batch:
+            pooled_cols.append(pooled)
+        else:
+            global_gmms[j] = fit_column_gmms(
+                [pooled], n_components=n_components, eps=eps, backend=backend,
+                seed=seed,
+            )[0]
+
+    if batch:
+        refits = fit_column_gmms(
+            pooled_cols, n_components=n_components, eps=eps, backend=backend,
+            seed=seed,
         )
+        for j, gmm in zip(cont_cols, refits):
+            global_gmms[j] = gmm
 
     return global_gmms, _normalize_per_column(wd, n_clients)
 
