@@ -217,15 +217,12 @@ def main(argv=None) -> int:
         )
         return 0
 
-    if args.backend == "cpu":
-        os.environ.setdefault(
-            "XLA_FLAGS",
-            f"--xla_force_host_platform_device_count={args.n_virtual_devices}",
-        )
     import jax
 
     if args.backend == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+        from fed_tgan_tpu.parallel.mesh import provision_virtual_cpu
+
+        provision_virtual_cpu(args.n_virtual_devices)
 
     import numpy as np
     import pandas as pd

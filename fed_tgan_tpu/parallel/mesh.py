@@ -21,6 +21,36 @@ from jax.sharding import Mesh
 CLIENTS_AXIS = "clients"
 
 
+def provision_virtual_cpu(n_devices: int) -> None:
+    """Force an ``n_devices`` virtual CPU platform (the tests/CI recipe).
+
+    Must run before any JAX backend initializes.  Sets
+    ``--xla_force_host_platform_device_count`` in XLA_FLAGS — replacing any
+    existing (possibly smaller) value — then overrides the platform through
+    the config API, because this environment pre-imports jax with
+    JAX_PLATFORMS=axon via a site hook, making the env-var route too late.
+    Raises RuntimeError if the devices don't materialize (i.e. a backend was
+    already initialized in this process).
+    """
+    import os
+    import re
+
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"could not provision {n_devices} virtual CPU devices "
+            f"(got {len(jax.devices())}); was a backend already initialized?"
+        )
+
+
 def client_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """A 1-D mesh over ``n_devices`` (default: all) with axis 'clients'."""
     if devices is None:
