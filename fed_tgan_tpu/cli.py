@@ -46,14 +46,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-selected_variables", "--selected", type=str, nargs="*",
                    default=None, help="columns to synthesize (reference "
                    "-selected_variables); default: preset list or all columns")
-    p.add_argument("--categorical", type=str, nargs="*", default=None)
-    p.add_argument("--non-negative", type=str, nargs="*", default=None)
-    p.add_argument("--date-format", type=str, nargs="*", default=None,
+    p.add_argument("-categorical_list", "--categorical", type=str, nargs="*",
+                   default=None, dest="categorical",
+                   help="categorical columns (reference -categorical_list)")
+    p.add_argument("-nonnegative_list", "--non-negative", type=str, nargs="*",
+                   default=None, dest="non_negative",
+                   help="log1p-transformed columns (reference -nonnegative_list)")
+    p.add_argument("-date_dic", "--date-format", type=str, nargs="*",
+                   default=None, dest="date_format",
                    help="date columns as col=FORMAT (e.g. when=YYYY-MM-DD); "
                         "the reference CLI's -date_dic")
-    p.add_argument("--target-column", type=str, default=None)
-    p.add_argument("--problem-type", type=str, default=None)
+    p.add_argument("-target_column", "--target-column", type=str, default=None,
+                   dest="target_column")
+    p.add_argument("-problem_type", "--problem-type", type=str, default=None,
+                   dest="problem_type")
+    p.add_argument("-name", "--name", type=str, default=None,
+                   help="run name for output artifacts (reference -name); "
+                        "default: preset name or the datapath basename")
     p.add_argument("-epochs", "--epochs", type=int, default=10)
+    p.add_argument("-E_interval", "--e-interval", type=int, default=None,
+                   dest="e_interval",
+                   help="accepted for drop-in compatibility; the reference "
+                        "accepts it too but never reads it (distributed.py:838)")
+    p.add_argument("-report", "--report", action="store_true",
+                   help="accepted for drop-in compatibility (reference -report)")
     p.add_argument("--n-clients", type=int, default=None)
     p.add_argument("--shard-strategy", type=str, default="iid",
                    choices=["iid", "contiguous", "label_sorted", "dirichlet"])
@@ -113,7 +129,7 @@ def _dataset_kwargs(args):
         )
         # -datapath always has the reference's default, so a name is always
         # derivable; the multihost server (rank 0) never reads the file
-        name = os.path.basename(args.datapath).rsplit(".", 1)[0]
+        name = args.name or os.path.basename(args.datapath).rsplit(".", 1)[0]
     else:
         preset = PRESETS[args.dataset]
         kwargs = preprocessor_kwargs(preset)
@@ -131,7 +147,7 @@ def _dataset_kwargs(args):
             kwargs["selected_columns"] = args.selected or None
         if args.date_format is not None:
             kwargs["date_formats"] = _parse_date_formats(args.date_format)
-        name = preset.name
+        name = args.name or preset.name
     return name, kwargs
 
 
@@ -157,7 +173,9 @@ def _run_multihost_init(args) -> int:
     if args.rank == 0:
         os.makedirs(os.path.join(args.out_dir, "models"), exist_ok=True)
         with ServerTransport(port, args.world_size - 1) as t:
-            out = server_initialize(t, seed=args.seed, weighted=not args.uniform)
+            out = server_initialize(
+                t, seed=args.seed, weighted=not args.uniform, run_name=name
+            )
         out["global_meta"].dump_json(os.path.join(args.out_dir, "models", f"{name}.json"))
         with open(
             os.path.join(args.out_dir, "models", f"label_encoders_{name}.pickle"), "wb"
@@ -176,11 +194,25 @@ def _run_multihost_init(args) -> int:
         pre = TablePreprocessor(frame=pd.read_csv(args.datapath), name=name, **kwargs)
         with ClientTransport(args.ip, port, args.rank) as t:
             out = client_initialize(t, pre, seed=args.seed)
+        # the server's run name wins so all ranks label artifacts alike even
+        # when launched with differently-named shard CSVs
+        name = out.get("run_name") or name
         print(
-            f"rank {args.rank} init complete: {out['matrix'].shape[0]} rows x "
+            f"rank {args.rank} ({name}) init complete: "
+            f"{out['matrix'].shape[0]} rows x "
             f"{out['matrix'].shape[1]} encoded dims; ready to join the mesh"
         )
     return 0
+
+
+def _eval_categorical_columns(kwargs) -> list:
+    """Columns to score with JSD in --eval: the categorical list plus any
+    date columns, which decode back to strings (e.g. '2023-05-12') and would
+    crash the continuous WD path's astype(float)."""
+    return list(kwargs["categorical_columns"]) + [
+        c for c in kwargs.get("date_formats", {})
+        if c not in kwargs["categorical_columns"]
+    ]
 
 
 def _parse_date_formats(items) -> dict:
@@ -253,6 +285,7 @@ def main(argv=None) -> int:
         # output paths stay stable even when flags aren't re-passed
         name = trainer.run_name or name
         kwargs["categorical_columns"] = init.global_meta.categorical_columns
+        kwargs["date_formats"] = dict(init.global_meta.date_info)
         frames = None
         if args.eval:
             try:
@@ -370,7 +403,7 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
 
         real = df[raw.columns.tolist()]
         avg_jsd, avg_wd, _ = statistical_similarity(
-            real, raw, kwargs["categorical_columns"]
+            real, raw, _eval_categorical_columns(kwargs)
         )
         print(f"final Avg_JSD={avg_jsd:.4f} Avg_WD={avg_wd:.4f}")
     return 0
@@ -461,7 +494,7 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
         # compare on the columns actually synthesized (the selected schema)
         full = pd.concat(frames)[fake.columns.tolist()]
         avg_jsd, avg_wd, _ = statistical_similarity(
-            full, fake, kwargs["categorical_columns"]
+            full, fake, _eval_categorical_columns(kwargs)
         )
         print(f"final Avg_JSD={avg_jsd:.4f} Avg_WD={avg_wd:.4f}")
 

@@ -41,12 +41,19 @@ def server_initialize(
     seed: int = 0,
     weighted: bool = True,
     backend: str = "sklearn",
+    run_name: str | None = None,
 ) -> dict:
-    """Drive the init protocol from rank 0; returns the global artifacts."""
+    """Drive the init protocol from rank 0; returns the global artifacts.
+
+    ``run_name`` rides along with the harmonized meta so every client labels
+    its artifacts consistently with the server's (clients may be launched
+    with differently-named shard CSVs)."""
     local_metas = transport.gather()
 
     global_meta_dict, encoders, jsd = harmonize_categories(local_metas)
-    transport.broadcast({"meta": global_meta_dict, "encoders": encoders})
+    transport.broadcast(
+        {"meta": global_meta_dict, "encoders": encoders, "run_name": run_name}
+    )
 
     infos = transport.gather()  # [{"gmms": [...], "rows": int}]
     client_gmms = [i["gmms"] for i in infos]
@@ -84,6 +91,7 @@ def client_initialize(
     msg = transport.recv_obj()
     global_meta = TableMeta.from_json_dict(msg["meta"])
     encoders = msg["encoders"]
+    run_name = msg.get("run_name")
 
     matrix, cat_idx, _ = preprocessor.encode(encoders)
     local_tf = ModeNormalizer(backend=backend, seed=seed).fit(matrix, cat_idx)
@@ -104,4 +112,5 @@ def client_initialize(
         "transformer": transformer,
         "matrix": encoded,
         "weights": weights,
+        "run_name": run_name,
     }

@@ -71,6 +71,30 @@ def harmonize_categories(
     """
     n_clients = len(local_metas)
     base = copy.deepcopy(local_metas[0])
+
+    # The merge below walks columns positionally (like the reference,
+    # Server/dtds/distributed.py:596-639, which assumes it silently); a
+    # client whose columns are named or ordered differently would get its
+    # frequency dicts credited to the wrong columns, so check explicitly.
+    def _signature(meta: dict) -> list[tuple[str, str]]:
+        return [(c.get("column_name", ""), c["type"]) for c in meta["columns"]]
+
+    base_sig = _signature(base)
+    for ci, meta in enumerate(local_metas[1:], start=1):
+        sig = _signature(meta)
+        if sig != base_sig:
+            mismatches = [
+                f"position {k}: client0 has {a!r}, client{ci} has {b!r}"
+                for k, (a, b) in enumerate(zip(base_sig, sig))
+                if a != b
+            ] or [f"column count {len(base_sig)} vs {len(sig)}"]
+            raise ValueError(
+                "client metas disagree on column names/types/order; category "
+                "harmonization merges positionally, so all clients must "
+                "present the same schema in the same order. "
+                + "; ".join(mismatches[:5])
+            )
+
     cat_cols = [i for i, c in enumerate(base["columns"]) if c["type"] == "categorical"]
 
     encoders: list[CategoryEncoder] = []

@@ -248,7 +248,14 @@ class RoundBookkeeping:
         """``timestamp_experiment.csv`` — one wall-clock value per round
         (reference distributed.py:827-829, excel dialect, single column) —
         plus ``timing_phases.csv`` with the per-phase breakdown the reference
-        collects but never writes (distributed.py:790-824)."""
+        collects but never writes (distributed.py:790-824).
+
+        When rounds are fused into one device program, per-round entries
+        inside a chunk are the chunk average (the device doesn't report
+        per-round boundaries); cumulative sums are exact at chunk boundaries,
+        which is where snapshots land, so the similarity CLI's cumulative
+        time charging stays exact.  Unfused runs record real per-round times
+        like the reference."""
         import csv
         import os
 

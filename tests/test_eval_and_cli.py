@@ -154,6 +154,36 @@ def test_cli_save_and_resume(tmp_path, toy_frame):
     assert synth.sample(50, seed=1).shape == (50, 4)
 
 
+def test_cli_reference_exact_flags_parse():
+    """The reference's full flag set (Server/dtds/distributed.py:894-932)
+    works with only the module name changed, including the README launch
+    line's '-epoch' abbreviation."""
+    from fed_tgan_tpu.cli import build_parser
+
+    p = build_parser()
+    a = p.parse_args(
+        "-ip 127.0.0.1 -rank 0 -epoch 500 -world_size 3 "
+        "-datapath data/raw/Intrusion_train.csv".split()
+    )
+    assert (a.rank, a.epochs, a.world_size) == (0, 500, 3)
+
+    a = p.parse_args([
+        "-name", "Intrusion_train", "-port", "7788", "-E_interval", "1",
+        "-report", "-problem_type", "binary_classification",
+        "-target_column", "class",
+        "-selected_variables", "duration", "protocol_type", "class",
+        "-categorical_list", "protocol_type", "class",
+        "-nonnegative_list", "dst_bytes", "src_bytes",
+        "-date_dic", "when=YYYY-MM-DD",
+    ])
+    assert a.name == "Intrusion_train" and a.report
+    assert a.target_column == "class" and a.problem_type == "binary_classification"
+    assert a.categorical == ["protocol_type", "class"]
+    assert a.non_negative == ["dst_bytes", "src_bytes"]
+    assert a.selected == ["duration", "protocol_type", "class"]
+    assert a.date_format == ["when=YYYY-MM-DD"]
+
+
 def test_cli_nonzero_rank_exits_cleanly():
     proc = subprocess.run(
         [sys.executable, "-m", "fed_tgan_tpu.cli", "-rank", "1"],
@@ -281,10 +311,12 @@ def test_cli_date_column_end_to_end(tmp_path, toy_frame):
             "--sample-rows", "80",
             "--backend", "cpu",
             "--out-dir", str(tmp_path),
+            "--eval",  # date column must be scored as categorical, not WD
         ],
         capture_output=True, text=True, env=env, cwd="/root/repo", timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "final Avg_JSD=" in proc.stdout
     snap = pd.read_csv(tmp_path / "toy_result" / "toy_synthesis_standalone.csv")
     assert "when" in snap.columns
     # rejoined dates parse as real dates (day clamping keeps them valid)

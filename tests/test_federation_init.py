@@ -47,6 +47,25 @@ def test_harmonize_categories_single_client_zero_fallback():
     assert jsd.tolist() == [[1.0]]
 
 
+def test_harmonize_categories_rejects_mismatched_schemas():
+    # shuffled column order across clients must be a loud error, not a
+    # silently-crossed positional merge
+    metas = [
+        _meta({"a": {"x": 3}, "b": {"y": 1}}),
+        _meta({"b": {"y": 4}, "a": {"x": 2}}),
+    ]
+    with pytest.raises(ValueError, match="same schema in the same order"):
+        harmonize_categories(metas)
+
+    # type mismatch at the same position is also rejected
+    metas = [
+        _meta({"a": {"x": 3}}),
+        _meta({"a": (0.0, 1.0)}),
+    ]
+    with pytest.raises(ValueError, match="client1 has"):
+        harmonize_categories(metas)
+
+
 def test_harmonize_continuous_golden():
     g_narrow = ColumnGMM(
         means=np.array([0.0]), stds=np.array([1.0]), weights=np.array([1.0]), active=np.array([True])

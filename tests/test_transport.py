@@ -153,6 +153,9 @@ def test_cli_multihost_init_processes(tmp_path):
     assert server.returncode == 0, out_s[-2000:]
     assert "multihost init complete: 2 clients" in out_s
     for r, oc in zip((1, 2), outs_c):
-        assert f"rank {r} init complete" in oc, oc[-2000:]
+        # "(shard0)" = the SERVER's run name, propagated through the init
+        # protocol — rank 2 was launched with shard1.csv but must label its
+        # artifacts with the server's name
+        assert f"rank {r} (shard0) init complete" in oc, oc[-2000:]
     assert (tmp_path / "models" / "shard0.json").exists()
     assert (tmp_path / "models" / "label_encoders_shard0.pickle").exists()
