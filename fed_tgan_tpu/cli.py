@@ -106,6 +106,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-world_size", "--world_size", type=int, default=None)
     p.add_argument("-ip", "--ip", type=str, default=None)
     p.add_argument("-port", "--port", type=int, default=None)
+    p.add_argument("--init-only", action="store_true",
+                   help="multihost mode: run only the federated init "
+                        "protocol, skip joining the training mesh")
     return p
 
 
@@ -154,9 +157,11 @@ def _dataset_kwargs(args):
 def _run_multihost_init(args) -> int:
     """Reference-style multi-process launch (reference run(),
     Server/dtds/distributed.py:838-891): rank 0 drives the init protocol,
-    ranks 1..N participate over the native TCP transport.  Produces the same
-    global artifacts as the in-process ``federated_initialize``; training
-    then runs as SPMD mesh slices (``jax.distributed``), not over RPC."""
+    ranks 1..N participate over the native TCP transport — then, unless
+    ``--init-only``, the whole world trains: every rank joins a
+    ``jax.distributed`` multi-controller mesh and runs ``-epochs`` federated
+    rounds as ONE cross-host SPMD program (train.multihost), with rank 0
+    owning the snapshot CSVs and timing artifacts like the reference server."""
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -170,38 +175,88 @@ def _run_multihost_init(args) -> int:
     if name is None:
         return 2
     port = args.port or 7788  # reference default port (distributed.py:898)
+    train_after = not args.init_only and args.epochs > 0
+
+    def join_mesh(rank: int) -> None:
+        from fed_tgan_tpu.parallel.multihost import initialize_multihost
+
+        initialize_multihost(
+            args.ip, port, args.world_size, rank,
+            backend=args.backend, n_local_devices=1,
+        )
+
+    def make_run():
+        from fed_tgan_tpu.train.multihost import MultihostRun
+
+        return MultihostRun(
+            epochs=args.epochs,
+            sample_every=args.sample_every,
+            sample_rows=args.sample_rows,
+            seed=args.seed,
+            log_every=0 if args.quiet else max(1, args.epochs // 10),
+        )
+
     if args.rank == 0:
         os.makedirs(os.path.join(args.out_dir, "models"), exist_ok=True)
         with ServerTransport(port, args.world_size - 1) as t:
             out = server_initialize(
                 t, seed=args.seed, weighted=not args.uniform, run_name=name
             )
-        out["global_meta"].dump_json(os.path.join(args.out_dir, "models", f"{name}.json"))
-        with open(
-            os.path.join(args.out_dir, "models", f"label_encoders_{name}.pickle"), "wb"
-        ) as f:
-            pickle.dump(
-                encoder_artifact(
-                    out["global_meta"].categorical_columns, out["encoders"]
-                ),
-                f,
+            out["global_meta"].dump_json(
+                os.path.join(args.out_dir, "models", f"{name}.json")
             )
-        print(
-            f"multihost init complete: {args.world_size - 1} clients, "
-            f"weights={[round(float(w), 4) for w in out['weights']]}"
-        )
+            with open(
+                os.path.join(args.out_dir, "models", f"label_encoders_{name}.pickle"),
+                "wb",
+            ) as f:
+                pickle.dump(
+                    encoder_artifact(
+                        out["global_meta"].categorical_columns, out["encoders"]
+                    ),
+                    f,
+                )
+            print(
+                f"multihost init complete: {args.world_size - 1} clients, "
+                f"weights={[round(float(w), 4) for w in out['weights']]}"
+            )
+            if train_after:
+                from fed_tgan_tpu.train.multihost import server_train
+
+                join_mesh(0)
+                books = server_train(
+                    t, out, make_run(), name,
+                    out_dir=args.out_dir, quiet=args.quiet,
+                )
+                books.write_timing(args.out_dir)
+                if not args.quiet:
+                    total = sum(books.epoch_times)
+                    n = max(books.completed_epochs, 1)
+                    print(
+                        f"{books.completed_epochs} rounds in {total:.1f}s "
+                        f"({total / n:.3f}s/round)"
+                    )
     else:
         pre = TablePreprocessor(frame=pd.read_csv(args.datapath), name=name, **kwargs)
         with ClientTransport(args.ip, port, args.rank) as t:
             out = client_initialize(t, pre, seed=args.seed)
-        # the server's run name wins so all ranks label artifacts alike even
-        # when launched with differently-named shard CSVs
-        name = out.get("run_name") or name
-        print(
-            f"rank {args.rank} ({name}) init complete: "
-            f"{out['matrix'].shape[0]} rows x "
-            f"{out['matrix'].shape[1]} encoded dims; ready to join the mesh"
-        )
+            # the server's run name wins so all ranks label artifacts alike
+            # even when launched with differently-named shard CSVs
+            name = out.get("run_name") or name
+            print(
+                f"rank {args.rank} ({name}) init complete: "
+                f"{out['matrix'].shape[0]} rows x "
+                f"{out['matrix'].shape[1]} encoded dims; ready to join the mesh"
+            )
+            if train_after:
+                from fed_tgan_tpu.train.multihost import client_train
+                from fed_tgan_tpu.train.steps import TrainConfig
+
+                join_mesh(args.rank)
+                cfg = TrainConfig(
+                    batch_size=args.batch_size, embedding_dim=args.embedding_dim
+                )
+                client_train(t, out, cfg, make_run())
+                print(f"rank {args.rank} training complete")
     return 0
 
 

@@ -62,11 +62,19 @@ def server_initialize(
     global_gmms, wd = harmonize_continuous(client_gmms, rows, seed=seed, backend=backend)
     transport.broadcast({"gmms": global_gmms})
 
+    # pooled conditional-sampling counts: the reference server rebuilds its
+    # Cond on the FULL training table (distributed.py:565-580); here the
+    # clients exchange additive one-hot counts instead of rows, so the
+    # pooled distribution is identical without centralizing any data
+    cond_counts = sum(transport.gather())
+
     if weighted:
         weights = aggregation_weights(jsd, wd, rows)
     else:
         weights = np.full(len(rows), 1.0 / len(rows))
-    transport.broadcast({"weights": weights})
+    transport.broadcast(
+        {"weights": weights, "rows_per_client": rows, "cond_counts": cond_counts}
+    )
 
     return {
         "global_meta": TableMeta.from_json_dict(global_meta_dict),
@@ -76,6 +84,7 @@ def server_initialize(
         "jsd": jsd,
         "wd": wd,
         "rows_per_client": rows,
+        "cond_counts": cond_counts,
     }
 
 
@@ -101,16 +110,28 @@ def client_initialize(
     transformer = ModeNormalizer(backend=backend, seed=seed).refit_with_global(
         global_meta, encoders, global_gmms
     )
+    # rank r holds client index r-1: the SAME rng stream the in-process
+    # federated_initialize gives that client, so a multihost world encodes
+    # (and therefore trains) bit-identically to the single-process path
     encoded = transformer.transform(
-        matrix, rng=np.random.default_rng(seed + transport.rank)
+        matrix, rng=np.random.default_rng(seed + transport.rank - 1)
     )
-    weights = transport.recv_obj()["weights"]
+
+    from fed_tgan_tpu.ops.segments import SegmentSpec
+    from fed_tgan_tpu.train.sampler import CondSampler
+
+    spec = SegmentSpec.from_output_info(transformer.output_info)
+    transport.send_obj(CondSampler.count_matrix(encoded, spec))
+
+    final = transport.recv_obj()
 
     return {
         "global_meta": global_meta,
         "encoders": encoders,
         "transformer": transformer,
         "matrix": encoded,
-        "weights": weights,
+        "weights": final["weights"],
+        "rows_per_client": final["rows_per_client"],
+        "cond_counts": final["cond_counts"],
         "run_name": run_name,
     }

@@ -1,0 +1,114 @@
+"""Multi-host runtime: ``jax.distributed`` bootstrap + the cross-process
+participant mesh.
+
+The reference spans hosts with PyTorch RPC worker processes (reference
+Server/dtds/distributed.py:838-891): rank 0 drives, ranks 1..N hold data and
+train.  Here the same world maps onto a multi-controller JAX program:
+
+- rank 0 = init-protocol server AND ``jax.distributed`` coordinator; its
+  devices exist in the global view but are excluded from the training mesh,
+  so it never launches the SPMD program (it services snapshots over the
+  native transport instead);
+- ranks 1..N = participants; each contributes one local device as one
+  position of the global ``clients`` mesh, and the per-round weighted-psum
+  FedAvg rides XLA collectives across hosts (gloo on CPU, ICI/DCN on TPU)
+  instead of RPC state_dict round-trips.
+
+The ``jax.distributed`` coordinator listens on ``port + 1`` — one above the
+native transport's rendezvous port, so one ``-ip``/``-port`` pair configures
+both planes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, provision_virtual_cpu
+
+JAX_PORT_OFFSET = 1
+
+
+def initialize_multihost(
+    ip: str,
+    port: int,
+    world_size: int,
+    rank: int,
+    backend: str | None = None,
+    n_local_devices: int = 1,
+) -> None:
+    """Join the multi-controller world (all ranks, including the server).
+
+    ``backend="cpu"`` provisions ``n_local_devices`` virtual CPU devices and
+    selects gloo cross-process collectives — the localhost test path and the
+    CI story (SURVEY §4).  On TPU each host's real chips are used as-is.
+    Must run before any JAX backend initializes in this process.
+    """
+    if backend == "cpu":
+        import os
+        import re
+
+        # same flag surgery as provision_virtual_cpu, but the device-count
+        # check must wait until after jax.distributed.initialize (jax.devices
+        # would initialize the backend pre-handshake and hang the rendezvous)
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_local_devices}"
+        ).strip()
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"{ip}:{port + JAX_PORT_OFFSET}",
+        num_processes=world_size,
+        process_id=rank,
+    )
+    # the global topology exchange needs EVERY process to bring its backend
+    # up (each publishes its local devices); rank 0 otherwise never would —
+    # it only services the transport — and the others would time out waiting
+    jax.devices()
+
+
+def participant_mesh() -> Mesh:
+    """1-D ``clients`` mesh over one device per participant process.
+
+    Mesh positions are ordered by process index, so mesh position c belongs
+    to transport rank c+1 — the same client numbering as the init protocol.
+    """
+    by_proc: dict[int, jax.Device] = {}
+    for d in sorted(jax.devices(), key=lambda d: (d.process_index, d.id)):
+        if d.process_index != 0:
+            by_proc.setdefault(d.process_index, d)
+    if not by_proc:
+        raise RuntimeError(
+            "no participant devices: the world has a single process (rank 0); "
+            "multi-host training needs world_size >= 2"
+        )
+    devices = [by_proc[p] for p in sorted(by_proc)]
+    return Mesh(np.asarray(devices), (CLIENTS_AXIS,))
+
+
+def from_local_chunk(mesh: Mesh, tree):
+    """Assemble global arrays sharded over 'clients' from each process's
+    local leading-axis chunk (participants call this; rank 0 owns no shard)."""
+    sharding = NamedSharding(mesh, P(CLIENTS_AXIS))
+    return jax.tree.map(
+        lambda leaf: jax.make_array_from_process_local_data(
+            sharding, np.asarray(leaf)
+        ),
+        tree,
+    )
+
+
+def local_shard(tree):
+    """Each leaf's process-local shard with the clients axis squeezed —
+    the participant's own view of a mesh-sharded result (post-psum model
+    state is replicated, so any participant's shard is the global value)."""
+    return jax.tree.map(
+        lambda leaf: np.asarray(leaf.addressable_shards[0].data)[0], tree
+    )

@@ -1,0 +1,259 @@
+"""Multi-host federated training: the reference's full multi-process run.
+
+The reference's one launch does init AND training across real processes
+(reference Server/dtds/distributed.py:838-891): per epoch, every client
+trains locally, ships G/D state_dicts to rank 0 over RPC, rank 0 averages,
+samples a synthetic snapshot, and ships the average back (:785-829).
+
+Here the same world trains as ONE multi-controller SPMD program:
+
+- after the init protocol (federation.distributed) each participant rank
+  joins the ``jax.distributed`` world and contributes one device to a global
+  ``clients`` mesh (parallel.multihost);
+- every participant executes the SAME fused-rounds program
+  (``make_federated_epoch``) — local steps then weighted-psum FedAvg — so
+  the per-epoch state_dict round-trips become XLA collectives across hosts;
+- the native transport stays open as the reference's control plane: rank 1
+  streams decoded snapshot matrices to rank 0, which (like the reference
+  server) owns the CSV artifacts and wall-clock bookkeeping; rank 0's
+  devices never join the mesh.
+
+Bit-compatibility: given the same shards, seed and config, the training
+trajectory is identical to the single-process ``FederatedTrainer`` — same
+init_models split protocol, same on-device key chain, same psum averaging —
+which the multihost test asserts parameter-for-parameter.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+
+from fed_tgan_tpu.ops.segments import SegmentSpec
+from fed_tgan_tpu.parallel.multihost import (
+    from_local_chunk,
+    local_shard,
+    participant_mesh,
+)
+from fed_tgan_tpu.train.federated import RoundBookkeeping, _pad_to, make_federated_epoch
+from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
+from fed_tgan_tpu.train.steps import (
+    SampleProgramCache,
+    TrainConfig,
+    init_models,
+)
+
+
+@dataclass(frozen=True)
+class MultihostRun:
+    """The per-run knobs shared by the server and client drivers."""
+
+    epochs: int
+    sample_every: int = 1
+    sample_rows: int = 40000
+    seed: int = 0
+    max_rounds_per_call: int = 16
+    log_every: int = 0
+
+
+def _snapshot_epochs(run: MultihostRun) -> set[int]:
+    """Rounds whose aggregated model gets a synthetic snapshot (CLI
+    semantics: every ``sample_every`` rounds, or only the last when 0)."""
+    if run.epochs <= 0:
+        return set()
+    if run.sample_every:
+        return {e for e in range(run.epochs) if e % run.sample_every == 0}
+    return {run.epochs - 1}
+
+
+def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun) -> dict:
+    """Train this participant's mesh slice (ranks >= 1).
+
+    Requires ``jax.distributed`` to be initialized (parallel.multihost).
+    Returns the final aggregated model params (host pytrees) after sending
+    them to rank 0 for the cross-host equality check.
+    """
+    spec = SegmentSpec.from_output_info(init_out["transformer"].output_info)
+    mesh = participant_mesh()
+    n_clients = int(mesh.devices.size)
+    c = transport.rank - 1
+
+    rows_per_client = [int(r) for r in init_out["rows_per_client"]]
+    if len(rows_per_client) != n_clients:
+        raise RuntimeError(
+            f"init protocol saw {len(rows_per_client)} clients but the mesh "
+            f"has {n_clients} participant devices"
+        )
+    matrix = np.asarray(init_out["matrix"], dtype=np.float32)
+    steps_local = len(matrix) // cfg.batch_size
+    steps_all = [r // cfg.batch_size for r in rows_per_client]
+    if min(steps_all) == 0:
+        small = [i for i, s in enumerate(steps_all) if s == 0]
+        raise ValueError(
+            f"clients {small} hold fewer than batch_size={cfg.batch_size} rows "
+            "(reference behavior: they would train 0 steps); rebalance shards "
+            "or shrink the batch"
+        )
+    max_steps = max(steps_all)
+    max_rows = max(rows_per_client)
+
+    # every participant pads its tables to the GLOBAL max shard size so the
+    # mesh-wide program has one static shape (same trick _stack_samplers
+    # plays in-process, using rows_per_client from the init protocol)
+    cond_local = CondSampler.from_data(matrix, spec)
+    rows_local = RowSampler.from_data(matrix, spec)
+    if spec.n_discrete:
+        # only row_pool scales with the shard's row count (CSR offsets/counts
+        # are n_opt-sized); zero-pad it exactly like _stack_samplers does
+        rows_local = RowSampler(
+            row_pool=_pad_to(rows_local.row_pool, spec.n_discrete * max_rows),
+            offsets=rows_local.offsets,
+            counts=rows_local.counts,
+            n_rows=rows_local.n_rows,
+            spec=spec,
+        )
+    data_local = _pad_to(matrix, max_rows)
+
+    add_axis = lambda tree: jax.tree.map(lambda leaf: np.asarray(leaf)[None], tree)
+    data_g = from_local_chunk(mesh, add_axis(data_local))
+    cond_g = from_local_chunk(mesh, add_axis(cond_local))
+    rows_g = from_local_chunk(mesh, add_axis(rows_local))
+    steps_g = from_local_chunk(mesh, np.asarray([steps_local], np.int32))
+    weights = np.asarray(init_out["weights"], dtype=np.float32)
+    weights_g = from_local_chunk(mesh, weights[c : c + 1])
+
+    # identical seeding protocol to FederatedTrainer.__init__: every rank
+    # derives the same initial models, so client c's chunk IS the stack row
+    key = jax.random.key(run.seed)
+    chain, init_key = jax.random.split(key)
+    one = init_models(init_key, spec, cfg)
+    models_g = from_local_chunk(mesh, add_axis(one))
+
+    # generation uses the POOLED empirical frequencies from the init
+    # protocol (the reference server's full-table Cond, distributed.py:565-580)
+    pooled_cond = CondSampler.from_counts(init_out["cond_counts"], spec)
+    from fed_tgan_tpu.ops.decode import make_device_decode
+
+    sampler = SampleProgramCache(
+        spec, cfg, decode_fn=make_device_decode(init_out["transformer"].columns)
+    )
+    firing = _snapshot_epochs(run)
+
+    epoch_fns: dict[int, object] = {}
+    e, end = 0, run.epochs
+    while e < end:
+        nxt = min((f for f in firing if f >= e), default=end - 1)
+        size = min(nxt - e + 1, run.max_rounds_per_call, end - e)
+        if size not in epoch_fns:
+            epoch_fns[size] = make_federated_epoch(
+                spec, cfg, max_steps, mesh, k=1, rounds=size
+            )
+        t0 = time.time()
+        models_g, metrics, chain = epoch_fns[size](
+            models_g, data_g, cond_g, rows_g, steps_g, weights_g, chain
+        )
+        jax.block_until_ready(models_g)
+        seconds = time.time() - t0
+        last = e + size - 1
+
+        if transport.rank == 1:
+            # rank 1 is the reporting participant: post-psum state is
+            # replicated, so its shard is the global model
+            msg = {"type": "chunk", "rounds": size, "seconds": seconds, "last": last}
+            if last in firing:
+                params_g = local_shard(models_g.params_g)
+                state_g = local_shard(models_g.state_g)
+                decoded = sampler.sample(
+                    params_g,
+                    state_g,
+                    pooled_cond,
+                    run.sample_rows,
+                    jax.random.key(run.seed + last + 29),
+                )
+                msg["snapshot"] = np.asarray(decoded, dtype=np.float64)
+            transport.send_obj(msg)
+        if run.log_every and (last % run.log_every == 0 or last == end - 1):
+            m = {k: float(np.asarray(v.addressable_shards[0].data).mean())
+                 for k, v in metrics.items()}
+            print(
+                f"[rank {transport.rank}] round {last}: "
+                f"loss_d={m['loss_d']:.3f} loss_g={m['loss_g']:.3f} "
+                f"({seconds / size:.3f}s/round)"
+            )
+        e += size
+
+    final_params = local_shard(models_g.params_g)
+    transport.send_obj({"type": "done", "params_g": final_params})
+    return {"params_g": final_params, "models": models_g}
+
+
+def server_train(
+    transport,
+    init_out: dict,
+    run: MultihostRun,
+    name: str,
+    out_dir: str = ".",
+    quiet: bool = False,
+) -> RoundBookkeeping:
+    """Rank 0's training-phase role: receive snapshots, own the artifacts.
+
+    Mirrors the reference server's fit() bookkeeping (distributed.py:785-829):
+    per-round wall-clock (from the reporting participant's chunk timings) plus
+    snapshot decode/write time, written by the caller via ``write_timing``.
+    Verifies the final aggregated params are identical on every host.
+    """
+    import os
+
+    from fed_tgan_tpu.data.decode import decode_matrix
+
+    result_dir = os.path.join(out_dir, f"{name}_result")
+    os.makedirs(result_dir, exist_ok=True)
+
+    books = RoundBookkeeping()
+    books._init_bookkeeping()
+
+    def write_snapshot(epoch: int, matrix: np.ndarray) -> None:
+        raw = decode_matrix(matrix, init_out["global_meta"], init_out["encoders"])
+        raw.to_csv(
+            os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv"),
+            index=False,
+        )
+
+    while True:
+        msg = transport.recv_obj(1)
+        if msg["type"] == "done":
+            finals = [msg["params_g"]]
+            break
+        per_round = msg["seconds"] / msg["rounds"]
+        snap = msg.get("snapshot")
+        for i in range(msg["rounds"]):
+            ei = msg["last"] - msg["rounds"] + 1 + i
+            hook = None
+            if snap is not None and ei == msg["last"]:
+                hook = lambda e, _b: write_snapshot(e, snap)
+            books._finish_round(per_round, ei, hook)
+        if run.log_every and not quiet and msg["last"] % run.log_every == 0:
+            print(f"[server] round {msg['last']}: {per_round:.3f}s/round")
+
+    finals += [
+        transport.recv_obj(rank)["params_g"]
+        for rank in range(2, transport.n_clients + 1)
+    ]
+    base_leaves = jax.tree.leaves(finals[0])
+    for r, tree in enumerate(finals[1:], start=2):
+        for a, b in zip(base_leaves, jax.tree.leaves(tree)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                raise RuntimeError(
+                    f"post-psum params differ between rank 1 and rank {r}: "
+                    "the cross-host FedAvg collective is broken"
+                )
+    if not quiet:
+        print(
+            f"final aggregated params identical across {len(finals)} hosts "
+            f"({books.completed_epochs} rounds)"
+        )
+    return books

@@ -43,21 +43,39 @@ class CondSampler:
     p_empirical: jax.Array
     spec: SegmentSpec
 
-    @classmethod
-    def from_data(cls, data: np.ndarray, spec: SegmentSpec) -> "CondSampler":
-        """data: transformed matrix (rows, spec.dim) with one-hot discrete blocks."""
+    @staticmethod
+    def count_matrix(data: np.ndarray, spec: SegmentSpec) -> np.ndarray:
+        """Per-discrete-column one-hot frequency counts, (n_discrete, max_size)
+        zero-padded.  Counts are additive across data shards, so pooled-table
+        sampling distributions can be built from per-client count exchanges
+        (multi-host init) without moving any rows."""
         max_size = int(spec.cond_sizes.max()) if spec.n_discrete else 1
-        p_train = np.zeros((max(spec.n_discrete, 1), max_size))
-        p_emp = np.zeros((max(spec.n_discrete, 1), max_size))
+        counts = np.zeros((max(spec.n_discrete, 1), max_size))
         for c in range(spec.n_discrete):
             dims = spec.discrete_dims[
                 spec.cond_offsets[c] : spec.cond_offsets[c] + spec.cond_sizes[c]
             ]
-            freq = data[:, dims].sum(axis=0)
+            counts[c, : len(dims)] = data[:, dims].sum(axis=0)
+        return counts
+
+    @classmethod
+    def from_counts(cls, counts: np.ndarray, spec: SegmentSpec) -> "CondSampler":
+        """Build from a ``count_matrix`` (possibly summed over shards)."""
+        counts = np.asarray(counts, dtype=np.float64)
+        p_train = np.zeros_like(counts)
+        p_emp = np.zeros_like(counts)
+        for c in range(spec.n_discrete):
+            size = int(spec.cond_sizes[c])
+            freq = counts[c, :size]
             logf = np.log(freq + 1.0)
-            p_train[c, : len(dims)] = logf / logf.sum()
-            p_emp[c, : len(dims)] = freq / max(freq.sum(), 1.0)
+            p_train[c, :size] = logf / logf.sum()
+            p_emp[c, :size] = freq / max(freq.sum(), 1.0)
         return cls(p_train=jnp.asarray(p_train), p_empirical=jnp.asarray(p_emp), spec=spec)
+
+    @classmethod
+    def from_data(cls, data: np.ndarray, spec: SegmentSpec) -> "CondSampler":
+        """data: transformed matrix (rows, spec.dim) with one-hot discrete blocks."""
+        return cls.from_counts(cls.count_matrix(data, spec), spec)
 
     def _draw(self, key: jax.Array, batch: int, probs: jax.Array):
         kcol, kopt = jax.random.split(key)
