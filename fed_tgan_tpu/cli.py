@@ -422,6 +422,7 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
     from fed_tgan_tpu.data.decode import decode_matrix
     from fed_tgan_tpu.data.ingest import TablePreprocessor
     from fed_tgan_tpu.federation.init import harmonize_categories
+    from fed_tgan_tpu.data.csvio import write_csv
     from fed_tgan_tpu.train.standalone import StandaloneSynthesizer
 
     df = pd.concat(frames) if len(frames) > 1 else frames[0]
@@ -442,7 +443,7 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
     decoded = synth.sample(args.sample_rows, seed=args.seed)
     raw = decode_matrix(decoded, table_meta, encoders)
     out_csv = os.path.join(result_dir, f"{name}_synthesis_standalone.csv")
-    raw.to_csv(out_csv, index=False)
+    write_csv(raw, out_csv)
     if not args.quiet:
         print(f"wrote {len(raw)} rows to {out_csv}")
 
@@ -467,6 +468,7 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
 def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     import pandas as pd
 
+    from fed_tgan_tpu.data.csvio import write_csv
     from fed_tgan_tpu.data.decode import decode_matrix
 
     result_dir = os.path.join(args.out_dir, f"{name}_result")
@@ -483,9 +485,8 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     def snapshot(epoch: int, tr) -> None:
         decoded = tr.sample(args.sample_rows, seed=args.seed + epoch)
         raw = decode_matrix(decoded, init.global_meta, init.encoders)
-        raw.to_csv(
-            os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv"),
-            index=False,
+        write_csv(
+            raw, os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv")
         )
 
     def snapshot_due(e: int) -> bool:

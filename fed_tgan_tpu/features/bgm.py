@@ -111,6 +111,9 @@ def fit_column_gmm(
     # all clients, so output dims are unaffected.
     n_components = max(1, min(n_components, len(x)))
     if backend == "sklearn":
+        import warnings
+
+        from sklearn.exceptions import ConvergenceWarning
         from sklearn.mixture import BayesianGaussianMixture
 
         gm = BayesianGaussianMixture(
@@ -120,7 +123,12 @@ def fit_column_gmm(
             n_init=1,
             random_state=seed,
         )
-        gm.fit(x)
+        with warnings.catch_warnings():
+            # the reference fits at these exact settings, where variational
+            # inference routinely hits max_iter on real columns; the partial
+            # fit is the parity behavior, so the warning is expected noise
+            warnings.simplefilter("ignore", ConvergenceWarning)
+            gm.fit(x)
         return ColumnGMM.from_sklearn(gm, eps)
     raise ValueError(f"unknown backend {backend!r}")
 

@@ -71,3 +71,85 @@ def make_device_decode(columns: Sequence) -> Callable[[jax.Array], jax.Array]:
         return jnp.stack(outs, axis=1)
 
     return decode
+
+
+def make_device_decode_packed(columns: Sequence):
+    """Like ``make_device_decode`` but with a transfer-minimal output layout.
+
+    Returns ``(decode_fn, assemble)``:
+
+    - ``decode_fn(encoded) -> {"cont": (n, n_cont) float32,
+      "disc": (n, n_disc) int8|int16}`` — discrete codes are exact small
+      ints, so shipping them as float32 wastes 2-4x the bytes.  On a
+      tunneled device the per-round snapshot transfer is the wall-clock
+      floor; this packing cuts it by ~25-40% for mixed tables.
+    - ``assemble(parts) -> (n, n_columns) float64`` — host-side scatter of
+      the two blocks back into original column order; output is identical
+      to ``make_device_decode``'s (then cast to float64).
+    """
+    cont_pos, disc_pos, max_code, min_code = [], [], 0, 0
+    for i, col in enumerate(columns):
+        if isinstance(col, ContinuousColumn):
+            cont_pos.append(i)
+        else:
+            assert isinstance(col, DiscreteColumn)
+            disc_pos.append(i)
+            if col.size:
+                max_code = max(max_code, int(np.max(col.codes)))
+                # fit()-path codes are raw column values and may be negative
+                min_code = min(min_code, int(np.min(col.codes)))
+    if -128 <= min_code and max_code <= 127:
+        int_dtype = jnp.int8
+    elif -32768 <= min_code and max_code <= 32767:
+        int_dtype = jnp.int16
+    else:
+        int_dtype = jnp.int32
+    full = make_device_decode(columns)  # reuse the per-column plan/semantics
+    n_cols = len(columns)
+    cont_idx = np.asarray(cont_pos, dtype=np.int32)
+    disc_idx = np.asarray(disc_pos, dtype=np.int32)
+
+    def decode(encoded: jax.Array) -> dict:
+        vals = full(encoded)
+        return {
+            "cont": vals[:, cont_idx] if len(cont_pos) else jnp.zeros(
+                (encoded.shape[0], 0), jnp.float32
+            ),
+            "disc": vals[:, disc_idx].astype(int_dtype) if len(disc_pos)
+            else jnp.zeros((encoded.shape[0], 0), int_dtype),
+        }
+
+    return decode, _make_assemble(cont_idx, disc_idx, n_cols)
+
+
+def _make_assemble(cont_idx: np.ndarray, disc_idx: np.ndarray, n_cols: int):
+    def assemble(parts: dict) -> np.ndarray:
+        cont = np.asarray(parts["cont"])
+        disc = np.asarray(parts["disc"])
+        n = cont.shape[0] if len(cont_idx) else disc.shape[0]
+        out = np.empty((n, n_cols), dtype=np.float64)
+        if len(cont_idx):
+            out[:, cont_idx] = cont
+        if len(disc_idx):
+            out[:, disc_idx] = disc
+        return out
+
+    return assemble
+
+
+def assemble_for_meta(meta):
+    """Host-side ``assemble`` built from a ``TableMeta`` alone — for
+    receivers of packed snapshot parts that never saw the transformer (e.g.
+    the multihost rank-0 server).  Column order in the packed blocks follows
+    the table's column order, which both the transformer's ``columns`` list
+    and ``meta.column_names`` share (decode_matrix relies on the same
+    invariant)."""
+    # discrete = categorical OR ordinal (both become DiscreteColumns in the
+    # transformer); partition on the column kind, not the categorical list
+    disc = [i for i, c in enumerate(meta.columns) if not c.is_continuous]
+    cont = [i for i, c in enumerate(meta.columns) if c.is_continuous]
+    return _make_assemble(
+        np.asarray(cont, dtype=np.int32),
+        np.asarray(disc, dtype=np.int32),
+        len(meta.column_names),
+    )

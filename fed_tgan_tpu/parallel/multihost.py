@@ -26,7 +26,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, provision_virtual_cpu
+from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS
 
 JAX_PORT_OFFSET = 1
 

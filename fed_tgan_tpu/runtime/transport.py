@@ -8,6 +8,11 @@ never touches this: it is an XLA collective on the device mesh.
 
 The shared library is built on demand with g++ (ctypes, no pybind11
 dependency) and cached next to the source.
+
+SECURITY: payloads are deserialized with ``pickle`` — the SAME trust model
+as the reference's torch RPC (arbitrary code execution if the peer is
+hostile).  Only run the init protocol between mutually trusted hosts on a
+trusted network, exactly as the reference assumes for its TCP rendezvous.
 """
 
 from __future__ import annotations

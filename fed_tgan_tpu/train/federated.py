@@ -110,12 +110,15 @@ def make_federated_epoch(
     sharded over 'clients', except ``key`` which is replicated):
     models, data, cond, rows, steps, weights, key.
 
-    Returns (models, metrics, next_key).  ``key`` is consumed like the host
-    loop does — one ``jax.random.split`` per round, on device — so running
-    one rounds=N program is BIT-IDENTICAL to N sequential rounds=1 calls
-    (fusing rounds between snapshots removes N-1 host round trips without
-    changing the training trajectory).  ``metrics`` gain a leading rounds
-    axis.
+    Returns (models, metrics, next_key, all_finite).  ``key`` is consumed
+    like the host loop does — one ``jax.random.split`` per round, on device —
+    so running one rounds=N program is BIT-IDENTICAL to N sequential
+    rounds=1 calls (fusing rounds between snapshots removes N-1 host round
+    trips without changing the training trajectory).  ``metrics`` gain a
+    leading rounds axis.  ``all_finite`` is a replicated scalar — divergence
+    detection reduced on device so the host fetches ONE bool per chunk
+    (device->host latency is the round's cost floor on a tunneled chip)
+    instead of every metric array.
     """
     step = make_train_step(spec, cfg)
 
@@ -171,15 +174,21 @@ def make_federated_epoch(
         (models, key), metrics = jax.lax.scan(
             round_body, (models, key), None, length=rounds
         )
-        return models, metrics, key
+        finite = jnp.stack(
+            [jnp.isfinite(m).all() for m in jax.tree.leaves(metrics)]
+        ).all()
+        # every client's verdict matters (a diverged client poisons the psum)
+        all_finite = jax.lax.pmin(finite.astype(jnp.int32), CLIENTS_AXIS) > 0
+        return models, metrics, key, all_finite
 
     sharded = P(CLIENTS_AXIS)
     fn = jax.shard_map(
         epoch_local,
         mesh=mesh,
         in_specs=(sharded, sharded, sharded, sharded, sharded, sharded, P()),
-        # metrics carry a leading rounds axis; the key chain is replicated
-        out_specs=(sharded, P(None, CLIENTS_AXIS), P()),
+        # metrics carry a leading rounds axis; the key chain and the finite
+        # flag are replicated
+        out_specs=(sharded, P(None, CLIENTS_AXIS), P(), P()),
         # the fused Pallas activation can't declare per-axis varying-ness on
         # its out_shape; its outputs are strictly per-client row blocks
         check_vma=False,
@@ -325,12 +334,15 @@ class FederatedTrainer(RoundBookkeeping):
         )
 
         self._epoch_fns: dict[int, Any] = {}
-        from fed_tgan_tpu.ops.decode import make_device_decode
+        self._device_stacks = None  # uploaded once on first fit()
+        from fed_tgan_tpu.ops.decode import make_device_decode_packed
 
         self._encoded_cache = SampleProgramCache(self.spec, self.cfg)
+        decode_fn, self._assemble = make_device_decode_packed(
+            init.transformers[0].columns
+        )
         self._decoded_cache = SampleProgramCache(
-            self.spec, self.cfg,
-            decode_fn=make_device_decode(init.transformers[0].columns),
+            self.spec, self.cfg, decode_fn=decode_fn,
         )
         # per-phase breakdown like the reference server's fit() lists
         # (time_training/time_aggregation/time_distribution, reference
@@ -368,11 +380,18 @@ class FederatedTrainer(RoundBookkeeping):
         wall-clock one call can hold).
         """
         models = self._shard(self.models)
-        data = self._shard(jnp.asarray(self.data_stack))
-        cond = self._shard(self.cond_stack)
-        rows = self._shard(self.rows_stack)
-        steps = self._shard(jnp.asarray(self.steps))
-        weights = self._shard(jnp.asarray(self.weights))
+        if self._device_stacks is None:
+            # the stacks never change between rounds; upload once and keep
+            # the device arrays (re-transferring ~MBs per fit() call is pure
+            # waste on a tunneled device)
+            self._device_stacks = (
+                self._shard(jnp.asarray(self.data_stack)),
+                self._shard(self.cond_stack),
+                self._shard(self.rows_stack),
+                self._shard(jnp.asarray(self.steps)),
+                self._shard(jnp.asarray(self.weights)),
+            )
+        data, cond, rows, steps, weights = self._device_stacks
 
         e = self.completed_epochs  # global round index (survives resume)
         end = e + epochs
@@ -387,14 +406,18 @@ class FederatedTrainer(RoundBookkeeping):
             nxt = min((f for f in firing if f >= e), default=end - 1)
             size = min(nxt - e + 1, max_rounds_per_call, end - e)
             t0 = time.time()
-            models, metrics, self._key = self._epoch_fn_for(size)(
+            models, metrics, self._key, finite = self._epoch_fn_for(size)(
                 models, data, cond, rows, steps, weights, self._key
             )
+            # divergence check: ONE scalar crosses to host (fetching it also
+            # serves as the chunk's sync point); the full metric arrays are
+            # pulled only on the failure path to name the bad round
+            if on_nonfinite != "ignore" and not bool(finite):
+                self._check_finite(metrics, e, on_nonfinite)
             # epoch_times feeds timestamp_experiment.csv — must measure the
             # chunk's real wall-clock, not async dispatch latency
             jax.block_until_ready(models)
             self.models = models
-            self._check_finite(metrics, e, on_nonfinite)
             per_round = (time.time() - t0) / size
             last = e + size - 1
             for ei in range(e, e + size):
@@ -432,9 +455,11 @@ class FederatedTrainer(RoundBookkeeping):
         """n decoded rows (numeric codes; feed to data.decode for raw CSV).
 
         Generation + inverse transform run as one device program per chunk;
-        only (chunk, n_columns) results cross to host."""
+        only the packed {float32 continuous, int8/16 discrete} blocks cross
+        to host (the snapshot transfer is the round's cost floor on a
+        tunneled chip), then scatter back to column order here."""
         params_g, state_g = self._global_model()
-        out = self._decoded_cache.sample(
+        parts = self._decoded_cache.sample(
             params_g, state_g, self.server_cond, n, jax.random.key(seed + 29)
         )
-        return out.astype(np.float64)
+        return self._assemble(parts)

@@ -263,12 +263,14 @@ class MDGANTrainer(RoundBookkeeping):
         self._epoch_fn = make_mdgan_epoch(
             self.spec, self.cfg, self.max_steps, self.mesh, self.k
         )
-        from fed_tgan_tpu.ops.decode import make_device_decode
+        from fed_tgan_tpu.ops.decode import make_device_decode_packed
 
         self._encoded_cache = SampleProgramCache(self.spec, self.cfg)
+        decode_fn, self._assemble = make_device_decode_packed(
+            init.transformers[0].columns
+        )
         self._decoded_cache = SampleProgramCache(
-            self.spec, self.cfg,
-            decode_fn=make_device_decode(init.transformers[0].columns),
+            self.spec, self.cfg, decode_fn=decode_fn,
         )
         # same per-phase split and timing-file contract as FederatedTrainer
         # so --mode mdgan numbers are comparable with fedavg runs
@@ -317,11 +319,11 @@ class MDGANTrainer(RoundBookkeeping):
         )
 
     def sample(self, n: int, seed: int = 0) -> np.ndarray:
-        out = self._decoded_cache.sample(
+        parts = self._decoded_cache.sample(
             self.gen.params, self.gen.state, self.server_cond, n,
             jax.random.key(seed + 29),
         )
-        return np.asarray(out).astype(np.float64)
+        return self._assemble(parts)
 
     def save_time_stamp(self, out_dir: str = ".") -> None:
         import os

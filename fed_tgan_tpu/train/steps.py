@@ -261,6 +261,9 @@ class SampleProgramCache:
         return self._programs[n_steps]
 
     def sample(self, params_g, state_g, cond: CondSampler, n: int, key):
+        """Sample n rows; result mirrors the program output (array or pytree
+        of arrays — e.g. the packed decode's {"cont", "disc"} dict), with
+        chunk results concatenated and trimmed to n rows per leaf."""
         import numpy as np
 
         total_steps = -(-n // self.cfg.batch_size)
@@ -275,9 +278,11 @@ class SampleProgramCache:
             # while chunk i transfers to host, but at most 2 chunk buffers
             # are ever live — generation stays memory-bounded no matter how
             # large the request
-            pending.append(self._program(steps)(params_g, state_g, cond, key, start))
+            chunk = self._program(steps)(params_g, state_g, cond, key, start)
+            jax.tree.map(lambda c: c.copy_to_host_async(), chunk)
+            pending.append(chunk)
             if len(pending) == 2:
-                out.append(np.asarray(pending.pop(0)))
+                out.append(jax.tree.map(np.asarray, pending.pop(0)))
             start += steps
-        out.extend(np.asarray(p) for p in pending)
-        return np.concatenate(out, axis=0)[:n]
+        out.extend(jax.tree.map(np.asarray, p) for p in pending)
+        return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0)[:n], *out)

@@ -2,22 +2,72 @@ import jax
 import numpy as np
 
 from fed_tgan_tpu.features.transformer import ModeNormalizer
-from fed_tgan_tpu.ops.decode import make_device_decode
+from fed_tgan_tpu.ops.decode import (
+    assemble_for_meta,
+    make_device_decode,
+    make_device_decode_packed,
+)
+
+
+def _fitted(n=500, cat_values=(5, 9, 11)):
+    rng = np.random.default_rng(2)
+    cont = np.concatenate([rng.normal(-3, 0.4, n // 2), rng.normal(2, 1.0, n - n // 2)])
+    cat = rng.choice(cat_values, n, p=[0.5, 0.3, 0.2]).astype(float)
+    data = np.stack([cont, cat], axis=1)
+    tf = ModeNormalizer(seed=0).fit(data, categorical_idx=[1])
+    enc = tf.transform(data, rng=np.random.default_rng(1))
+    return tf, enc
 
 
 def test_device_decode_matches_host_inverse():
-    rng = np.random.default_rng(2)
-    n = 500
-    cont = np.concatenate([rng.normal(-3, 0.4, n // 2), rng.normal(2, 1.0, n - n // 2)])
-    cat = rng.choice([5, 9, 11], n, p=[0.5, 0.3, 0.2]).astype(float)  # sparse codes
-    data = np.stack([cont, cat], axis=1)
-
-    tf = ModeNormalizer(seed=0).fit(data, categorical_idx=[1])
-    enc = tf.transform(data, rng=np.random.default_rng(1))
-
+    tf, enc = _fitted()
     host = tf.inverse_transform(enc)
     dev = np.asarray(jax.jit(make_device_decode(tf.columns))(enc))
 
     assert dev.shape == host.shape
     assert np.allclose(dev[:, 1], host[:, 1])  # codes exact
     assert np.allclose(dev[:, 0], host[:, 0], atol=1e-4)
+
+
+def test_packed_decode_assemble_matches_full():
+    tf, enc = _fitted()
+    full = np.asarray(jax.jit(make_device_decode(tf.columns))(enc))
+    decode_fn, assemble = make_device_decode_packed(tf.columns)
+    parts = jax.jit(decode_fn)(enc)
+    assert np.asarray(parts["disc"]).dtype == np.int8  # codes fit one byte
+    packed = assemble(jax.tree.map(np.asarray, parts))
+    assert packed.dtype == np.float64
+    np.testing.assert_array_equal(packed, full.astype(np.float64))
+
+
+def test_packed_decode_int_dtype_tiers():
+    _, _ = _fitted()
+    for hi, want in ((126, np.int8), (32000, np.int16), (70000, np.int32)):
+        tf, enc = _fitted(cat_values=(0, 1, hi))
+        decode_fn, assemble = make_device_decode_packed(tf.columns)
+        parts = jax.tree.map(np.asarray, jax.jit(decode_fn)(enc))
+        assert parts["disc"].dtype == want, (hi, parts["disc"].dtype)
+        full = np.asarray(jax.jit(make_device_decode(tf.columns))(enc))
+        np.testing.assert_array_equal(assemble(parts), full.astype(np.float64))
+
+
+def test_assemble_for_meta_matches_transformer_layout():
+    """The multihost server rebuilds assemble from TableMeta alone; it must
+    scatter identically to the transformer-derived one."""
+    from fed_tgan_tpu.data.schema import TableMeta
+
+    tf, enc = _fitted()
+    decode_fn, assemble = make_device_decode_packed(tf.columns)
+    parts = jax.tree.map(np.asarray, jax.jit(decode_fn)(enc))
+
+    meta = TableMeta.from_json_dict(
+        {
+            "columns": [
+                {"column_name": "x", "type": "continous", "min": 0.0, "max": 1.0},
+                {"column_name": "c", "type": "categorical", "size": 3,
+                 "i2s": ["a", "b", "c"]},
+            ]
+        }
+    )
+    via_meta = assemble_for_meta(meta)(parts)
+    np.testing.assert_array_equal(via_meta, assemble(parts))
