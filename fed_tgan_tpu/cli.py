@@ -82,6 +82,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "split-model (shared generator, local discriminators)")
     p.add_argument("--backend", type=str, default=None, choices=[None, "tpu", "cpu"],
                    help="cpu = virtual-device mesh (see --n-virtual-devices)")
+    p.add_argument("--bgm-backend", type=str, default="sklearn",
+                   choices=["sklearn", "jax"],
+                   help="per-column Bayesian-GMM fitter for init: sklearn = "
+                        "reference-exact estimator on host; jax = one vmapped "
+                        "variational-DP program on device (much faster init)")
     p.add_argument("--n-virtual-devices", type=int, default=8)
     p.add_argument("--batch-size", type=int, default=500)
     p.add_argument("--embedding-dim", type=int, default=128)
@@ -200,7 +205,8 @@ def _run_multihost_init(args) -> int:
         os.makedirs(os.path.join(args.out_dir, "models"), exist_ok=True)
         with ServerTransport(port, args.world_size - 1) as t:
             out = server_initialize(
-                t, seed=args.seed, weighted=not args.uniform, run_name=name
+                t, seed=args.seed, weighted=not args.uniform,
+                backend=args.bgm_backend, run_name=name,
             )
             out["global_meta"].dump_json(
                 os.path.join(args.out_dir, "models", f"{name}.json")
@@ -238,7 +244,7 @@ def _run_multihost_init(args) -> int:
     else:
         pre = TablePreprocessor(frame=pd.read_csv(args.datapath), name=name, **kwargs)
         with ClientTransport(args.ip, port, args.rank) as t:
-            out = client_initialize(t, pre, seed=args.seed)
+            out = client_initialize(t, pre, seed=args.seed, backend=args.bgm_backend)
             # the server's run name wins so all ranks label artifacts alike
             # even when launched with differently-named shard CSVs
             name = out.get("run_name") or name
@@ -397,7 +403,10 @@ def main(argv=None) -> int:
     if not args.quiet:
         print(f"{n_clients} clients, rows per shard: {[c.n_rows for c in clients]}")
         print("running federated initialization (harmonize + GMM refit)...")
-    init = federated_initialize(clients, seed=args.seed, weighted=not args.uniform)
+    init = federated_initialize(
+        clients, seed=args.seed, backend=args.bgm_backend,
+        weighted=not args.uniform,
+    )
     if not args.quiet:
         print(f"init done in {time.time() - t_init:.1f}s; "
               f"aggregation weights: {np.round(init.weights, 4).tolist()}")
@@ -431,7 +440,10 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
     meta, encoders, _ = harmonize_categories([pre.local_meta()])
     matrix, cat_idx, ord_idx = pre.encode(encoders)
 
-    synth = StandaloneSynthesizer(config=cfg, seed=args.seed, verbose=not args.quiet)
+    synth = StandaloneSynthesizer(
+        config=cfg, seed=args.seed, verbose=not args.quiet,
+        bgm_backend=args.bgm_backend,
+    )
     t0 = time.time()
     synth.fit(matrix, cat_idx, ord_idx, epochs=args.epochs)
     if not args.quiet:

@@ -20,6 +20,10 @@ import pandas as pd
 
 
 def _arrow_friendly(df: pd.DataFrame) -> bool:
+    if df.shape[1] == 1:
+        # arrow writes a null in a one-column frame as a blank line, which
+        # pd.read_csv(skip_blank_lines=True) drops — rows would vanish
+        return False
     for name in df.columns:
         col = df[name]
         if str(col.dtype).startswith(("datetime", "timedelta")):

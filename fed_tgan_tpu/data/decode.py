@@ -47,9 +47,14 @@ def decode_matrix(
         if name in meta.non_negative_columns:
             x = np.exp(df[name].astype(float).to_numpy()) - 1.0
             x = np.where(x < 0, np.ceil(x), x)
-            vals = pd.Series(x, index=df.index, dtype=object)
-            vals[x == -1] = MISSING_TOKEN
-            df[name] = vals
+            if (x == -1).any():
+                vals = pd.Series(x, index=df.index, dtype=object)
+                vals[x == -1] = MISSING_TOKEN
+                df[name] = vals
+            else:
+                # keep the numeric dtype: identical CSV output, and the
+                # frame stays on the fast (pyarrow) snapshot-writer path
+                df[name] = x
         elif name in cont_names:
             x = df[name].astype(float).to_numpy()
             if (x == MISSING_CONTINUOUS).any():

@@ -130,6 +130,10 @@ def fit_column_gmm(
             warnings.simplefilter("ignore", ConvergenceWarning)
             gm.fit(x)
         return ColumnGMM.from_sklearn(gm, eps)
+    if backend == "jax":
+        from fed_tgan_tpu.features.bgm_jax import fit_columns_jax
+
+        return fit_columns_jax([x.reshape(-1)], n_components, eps)[0]
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -167,6 +171,12 @@ def fit_column_gmms(
     per-client fits parallelize across hosts via the multihost init protocol
     (federation/distributed.py) instead.
     """
+    if backend == "jax":
+        # the whole batch is ONE vmapped device program — worker processes
+        # would only add dispatch overhead
+        from fed_tgan_tpu.features.bgm_jax import fit_columns_jax
+
+        return fit_columns_jax(list(columns), n_components, eps)
     if max_workers is None:
         max_workers = resolved_init_workers()
     jobs = [(np.asarray(c, dtype=np.float64), n_components, eps, backend, seed)

@@ -157,8 +157,9 @@ def harmonize_continuous(
     # sampling + WD stay serial (they share one rng stream and are cheap).
     # Pooled refits go to a process pool only when workers are opted in —
     # batching every column's pooled sample first would otherwise raise peak
-    # memory from O(rows) to O(cols x rows) for nothing.
-    batch = resolved_init_workers() > 1
+    # memory from O(rows) to O(cols x rows) for nothing.  The jax backend
+    # always batches: the whole refit is one vmapped device program.
+    batch = resolved_init_workers() > 1 or backend == "jax"
     pooled_cols = []
     for cursor, j in enumerate(cont_cols):
         samples = [

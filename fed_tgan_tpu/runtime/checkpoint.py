@@ -125,6 +125,15 @@ def load_federated(path: str, mesh=None):
         else:
             trainer.models = _load_leaves(trainer.models, data)
         trainer._key = jax.random.wrap_key_data(data["rng_key"])
+        if kind != "mdgan":
+            # keep the key committed to the mesh like __init__ does, so the
+            # resumed run's epoch programs compile once (uncommitted-then-
+            # committed key shardings would compile each chunk size twice)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            trainer._key = jax.device_put(
+                trainer._key, NamedSharding(trainer.mesh, P())
+            )
     trainer.completed_epochs = host["completed_epochs"]
     trainer.epoch_times = list(host["epoch_times"])
     if hasattr(trainer, "phase_times"):

@@ -327,6 +327,10 @@ class FederatedTrainer(RoundBookkeeping):
         # clients alike and the server adopts client 0's, distributed.py:789)
         key = jax.random.key(seed)
         self._key, init_key = jax.random.split(key)
+        # commit the key chain to the mesh now: the epoch program's first
+        # call would otherwise see an UnspecifiedValue-sharded key and its
+        # second call a committed P() one — two identical ~8s compilations
+        self._key = jax.device_put(self._key, NamedSharding(self.mesh, P()))
         one = init_models(init_key, self.spec, self.cfg)
         self.models = jax.tree.map(
             lambda x: np.broadcast_to(np.asarray(x)[None], (n_clients,) + np.shape(x)).copy(),
@@ -411,13 +415,17 @@ class FederatedTrainer(RoundBookkeeping):
             )
             # divergence check: ONE scalar crosses to host (fetching it also
             # serves as the chunk's sync point); the full metric arrays are
-            # pulled only on the failure path to name the bad round
-            if on_nonfinite != "ignore" and not bool(finite):
-                self._check_finite(metrics, e, on_nonfinite)
+            # pulled only on the failure path to name the bad round.  State
+            # (models AND the already-advanced key chain) is committed BEFORE
+            # any raise so a checkpoint taken by an error handler stays
+            # consistent.
+            ok = on_nonfinite == "ignore" or bool(finite)
             # epoch_times feeds timestamp_experiment.csv — must measure the
             # chunk's real wall-clock, not async dispatch latency
             jax.block_until_ready(models)
             self.models = models
+            if not ok:
+                self._check_finite(metrics, e, on_nonfinite)
             per_round = (time.time() - t0) / size
             last = e + size - 1
             for ei in range(e, e + size):

@@ -34,10 +34,12 @@ class StandaloneSynthesizer:
         config: TrainConfig | None = None,
         seed: int = 0,
         verbose: bool = False,
+        bgm_backend: str = "sklearn",
     ):
         self.cfg = config or TrainConfig()
         self.seed = seed
         self.verbose = verbose
+        self.bgm_backend = bgm_backend
         self.transformer: Optional[ModeNormalizer] = None
         self.models: Optional[ModelBundle] = None
 
@@ -48,9 +50,9 @@ class StandaloneSynthesizer:
         ordinal_idx: Sequence[int] = (),
         epochs: int = 3,
     ) -> "StandaloneSynthesizer":
-        self.transformer = ModeNormalizer(seed=self.seed).fit(
-            data, categorical_idx, ordinal_idx
-        )
+        self.transformer = ModeNormalizer(
+            backend=self.bgm_backend, seed=self.seed
+        ).fit(data, categorical_idx, ordinal_idx)
         rng = np.random.default_rng(self.seed)
         train = self.transformer.transform(data, rng=rng)
         self.spec = SegmentSpec.from_output_info(self.transformer.output_info)

@@ -28,6 +28,7 @@ def fed_init(toy_frame, toy_spec):
     return federated_initialize(clients, seed=0)
 
 
+@pytest.mark.slow
 def test_federated_resume_is_bit_exact(fed_init, tmp_path):
     """1 round + save/load + 1 round == 2 uninterrupted rounds."""
     mesh = client_mesh(4)

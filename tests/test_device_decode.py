@@ -41,7 +41,6 @@ def test_packed_decode_assemble_matches_full():
 
 
 def test_packed_decode_int_dtype_tiers():
-    _, _ = _fitted()
     for hi, want in ((126, np.int8), (32000, np.int16), (70000, np.int32)):
         tf, enc = _fitted(cat_values=(0, 1, hi))
         decode_fn, assemble = make_device_decode_packed(tf.columns)

@@ -95,6 +95,7 @@ def test_cli_end_to_end(tmp_path, toy_frame):
     assert set(snap["color"].unique()) <= {"red", "green", "blue"}
 
 
+@pytest.mark.slow
 def test_cli_save_and_resume(tmp_path, toy_frame):
     data_p = tmp_path / "toy.csv"
     toy_frame.to_csv(data_p, index=False)

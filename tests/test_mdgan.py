@@ -148,6 +148,7 @@ def test_mdgan_k2_layout(fed_init):
     assert out.shape == (50, 4)
 
 
+@pytest.mark.slow
 def test_mdgan_resume_is_bit_exact(fed_init, tmp_path):
     """1 round + save/load + 1 round == 2 uninterrupted rounds (split model)."""
     from fed_tgan_tpu.runtime.checkpoint import load_federated, save_federated

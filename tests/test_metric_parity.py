@@ -1,0 +1,69 @@
+"""End-to-end metric-parity regression against the reference's published
+numbers (reference README.md:44-68 — its de-facto verification, SURVEY §4).
+
+The reference's demo reaches Avg_JSD 0.082 / Avg_WD 0.04 at epoch 1
+(README.md:54) on the full Intrusion training table.  Only the 10,098-row
+test split survives in the snapshot, so each participant here holds ~5k rows
+(10 steps/round vs the reference's hundreds) — a *harder* setup per round.
+The pinned horizon below was calibrated on the virtual-CPU mesh: the
+trajectory is seeded and the fused-round program is bit-stable, so this is a
+true regression test, not a flaky convergence bet.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fed_tgan_tpu.data.decode import decode_matrix
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.datasets import INTRUSION, preprocessor_kwargs
+from fed_tgan_tpu.eval.similarity import statistical_similarity
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+
+REF_CSV = "/root/reference/Server/data/raw/Intrusion_test.csv"
+
+# reference README.md:54 (epoch 1 of the 2-client demo)
+REF_EPOCH1_AVG_JSD = 0.082
+REF_EPOCH1_AVG_WD = 0.04
+
+# Calibrated on the virtual-CPU mesh (seeded, deterministic trajectory):
+# JSD crosses 0.082 before round 20; WD reaches 0.037 at round 120
+# (sampling-variance margin ~7% under the 0.04 bar).
+ROUNDS = 120
+SAMPLE_ROWS = 10000
+
+
+@pytest.mark.slow
+def test_reference_epoch1_similarity_is_met():
+    df = pd.read_csv(REF_CSV)
+    kwargs = preprocessor_kwargs(INTRUSION)
+    selected = kwargs.pop("selected_columns")
+    frames = shard_dataframe(df, 2, "iid", seed=0)
+    clients = [
+        TablePreprocessor(
+            frame=f, name="Intrusion", selected_columns=selected, **kwargs
+        )
+        for f in frames
+    ]
+    init = federated_initialize(clients, seed=0)
+    trainer = FederatedTrainer(init, config=TrainConfig(), seed=0)
+    trainer.fit(ROUNDS)  # no hook: rounds fuse into few device programs
+
+    decoded = trainer.sample(SAMPLE_ROWS, seed=1)
+    raw = decode_matrix(decoded, init.global_meta, init.encoders)
+    real = df[init.global_meta.column_names]
+    avg_jsd, avg_wd, _ = statistical_similarity(
+        real, raw, init.global_meta.categorical_columns
+    )
+    assert np.isfinite(avg_jsd) and np.isfinite(avg_wd)
+    assert avg_jsd <= REF_EPOCH1_AVG_JSD, (
+        f"Avg_JSD {avg_jsd:.4f} worse than reference epoch-1 "
+        f"{REF_EPOCH1_AVG_JSD} after {ROUNDS} rounds"
+    )
+    assert avg_wd <= REF_EPOCH1_AVG_WD, (
+        f"Avg_WD {avg_wd:.4f} worse than reference epoch-1 "
+        f"{REF_EPOCH1_AVG_WD} after {ROUNDS} rounds"
+    )

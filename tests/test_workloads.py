@@ -82,6 +82,7 @@ def test_adult_noniid_dirichlet_8clients():
     assert (raw["capital-gain"].astype(float) >= 0).all()  # log1p inverse
 
 
+@pytest.mark.slow
 def test_covertype_32clients_4_per_device_with_utility():
     df = _covertype_like()
     frames = shard_dataframe(df, 32, "iid", seed=5)
