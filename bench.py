@@ -100,33 +100,37 @@ def bench_full500(
     from fed_tgan_tpu.data.decode import decode_matrix
     from fed_tgan_tpu.eval.similarity import statistical_similarity
 
+    if epochs < 1:
+        raise ValueError("full500 workload needs epochs >= 1")
     t_start = time.time()
     df, init, trainer = _setup(n_clients=n_clients, weighted=weighted)
 
     result_dir = os.path.join(out_dir, "Intrusion_result")
     os.makedirs(result_dir, exist_ok=True)
     last_raw = {}
-    pool = cf.ThreadPoolExecutor(max_workers=1)
     pending = []
 
-    def snapshot(epoch: int, tr) -> None:
-        decoded = tr.sample(40000, seed=epoch)
-        raw = decode_matrix(decoded, init.global_meta, init.encoders)
-        while len(pending) > 1:  # backpressure: at most one write in flight
-            pending.pop(0).result()
-        pending.append(
-            pool.submit(
-                write_csv,
-                raw,
-                os.path.join(result_dir, f"Intrusion_synthesis_epoch_{epoch}.csv"),
-            )
-        )
-        last_raw["df"] = raw
+    with cf.ThreadPoolExecutor(max_workers=1) as pool:
 
-    trainer.fit(epochs, sample_hook=snapshot)
-    for fut in pending:
-        fut.result()
-    pool.shutdown()
+        def snapshot(epoch: int, tr) -> None:
+            decoded = tr.sample(40000, seed=epoch)
+            raw = decode_matrix(decoded, init.global_meta, init.encoders)
+            while len(pending) > 1:  # backpressure: one write in flight
+                pending.pop(0).result()
+            pending.append(
+                pool.submit(
+                    write_csv,
+                    raw,
+                    os.path.join(
+                        result_dir, f"Intrusion_synthesis_epoch_{epoch}.csv"
+                    ),
+                )
+            )
+            last_raw["df"] = raw
+
+        trainer.fit(epochs, sample_hook=snapshot)
+        for fut in pending:
+            fut.result()
     trainer.write_timing(out_dir)
     total = time.time() - t_start
 
@@ -134,8 +138,9 @@ def bench_full500(
     avg_jsd, avg_wd, _ = statistical_similarity(
         real, last_raw["df"], init.global_meta.categorical_columns
     )
+    suffix = "" if weighted else "(uniform)"
     return {
-        "metric": f"intrusion_{n_clients}client_full{epochs}_seconds",
+        "metric": f"intrusion_{n_clients}client_full{epochs}_seconds{suffix}",
         "value": round(total, 2),
         "unit": "s",
         "vs_baseline": round(epochs * BASELINE_EPOCH_SECONDS / total, 2),
@@ -162,8 +167,6 @@ def main() -> int:
         out = bench_full500(
             args.epochs, n_clients=args.clients, weighted=not args.uniform
         )
-        if args.uniform:
-            out["metric"] += "(uniform)"
     print(json.dumps(out))
     return 0
 

@@ -1,0 +1,133 @@
+"""Gradient-flow diagnostics.
+
+The reference ships (commented-out) matplotlib gradient-flow plotting inside
+its training loop for debugging vanishing/exploding gradients (reference
+Server/dtds/synthesizers/ctgan.py:261-306, call sites :432,:438).  Here the
+same diagnostic is a pure function over one training step's gradients —
+computed on device in one jitted call, summarized per layer — plus an
+optional matplotlib rendering.  It never touches the hot loop: call it
+ad hoc on a trainer's current state when a run misbehaves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fed_tgan_tpu.models.ctgan import discriminator_apply, generator_apply
+from fed_tgan_tpu.models.losses import gradient_penalty
+from fed_tgan_tpu.ops.segments import SegmentSpec, apply_activate, cond_loss
+from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
+from fed_tgan_tpu.train.steps import ModelBundle, TrainConfig
+
+
+def summarize_grads(grads) -> dict:
+    """{leaf_path: {"avg_abs": float, "max_abs": float}} — the same per-layer
+    statistics the reference's plot collects (ave_grads/max_grads)."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        name = "/".join(
+            getattr(p, "name", None) or str(getattr(p, "idx", p)) for p in path
+        )
+        arr = np.asarray(leaf)
+        out[name] = {
+            "avg_abs": float(np.abs(arr).mean()),
+            "max_abs": float(np.abs(arr).max()),
+        }
+    return out
+
+
+def gradient_flow(
+    models: ModelBundle,
+    data,
+    cond: CondSampler,
+    rows: RowSampler,
+    spec: SegmentSpec,
+    cfg: TrainConfig,
+    key: jax.Array,
+) -> dict:
+    """Per-layer gradient statistics for one D step and one G step, from the
+    same batch-construction path the real train step uses."""
+    keys = jax.random.split(key, 13)
+    B = cfg.batch_size
+    has_cond = spec.n_discrete > 0
+
+    z = jax.random.normal(keys[0], (B, cfg.embedding_dim))
+    if has_cond:
+        c1, m1, col, opt_idx = cond.sample_train(keys[1], B)
+        perm = jax.random.permutation(keys[2], B)
+        row_idx = rows.sample_rows(keys[3], col[perm], opt_idx[perm])
+        c2 = c1[perm]
+        gen_in = jnp.concatenate([z, c1], axis=1)
+    else:
+        row_idx = rows.sample_uniform(keys[3], B)
+        gen_in = z
+    real = jnp.asarray(data)[row_idx]
+
+    fake_raw, state_g2 = generator_apply(
+        models.params_g, models.state_g, gen_in, train=True
+    )
+    fake_act = apply_activate(fake_raw, spec, keys[4])
+    if has_cond:
+        fake_cat = jnp.concatenate([fake_act, c1], axis=1)
+        real_cat = jnp.concatenate([real, c2], axis=1)
+    else:
+        fake_cat, real_cat = fake_act, real
+    fake_cat = jax.lax.stop_gradient(fake_cat)
+
+    def d_loss(params_d):
+        y_fake = discriminator_apply(params_d, fake_cat, keys[5], cfg.pac)
+        y_real = discriminator_apply(params_d, real_cat, keys[6], cfg.pac)
+        pen = gradient_penalty(
+            lambda x: discriminator_apply(params_d, x, keys[7], cfg.pac),
+            real_cat, fake_cat, keys[8], pac=cfg.pac,
+        )
+        return jnp.mean(y_fake) - jnp.mean(y_real) + pen
+
+    def g_loss(params_g):
+        raw, _ = generator_apply(params_g, state_g2, gen_in, train=True)
+        act = apply_activate(raw, spec, keys[11])
+        d_in = jnp.concatenate([act, c1], axis=1) if has_cond else act
+        y_fake = discriminator_apply(models.params_d, d_in, keys[12], cfg.pac)
+        ce = cond_loss(raw, spec, c1, m1) if has_cond else 0.0
+        return -jnp.mean(y_fake) + ce
+
+    grads_d = jax.jit(jax.grad(d_loss))(models.params_d)
+    grads_g = jax.jit(jax.grad(g_loss))(models.params_g)
+    return {
+        "discriminator": summarize_grads(grads_d),
+        "generator": summarize_grads(grads_g),
+    }
+
+
+def plot_gradient_flow(stats: dict, path: Optional[str] = None):
+    """Render the reference's gradient-flow bar chart (avg+max abs per layer).
+
+    Requires matplotlib; returns the figure (saved to ``path`` if given)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, len(stats), figsize=(7 * len(stats), 4))
+    if len(stats) == 1:
+        axes = [axes]
+    for ax, (net, layers) in zip(axes, stats.items()):
+        names = list(layers)
+        avg = [layers[n]["avg_abs"] for n in names]
+        mx = [layers[n]["max_abs"] for n in names]
+        x = np.arange(len(names))
+        ax.bar(x, mx, alpha=0.4, label="max |grad|")
+        ax.bar(x, avg, alpha=0.8, label="avg |grad|")
+        ax.set_xticks(x)
+        ax.set_xticklabels(names, rotation=90, fontsize=6)
+        ax.set_yscale("log")
+        ax.set_title(f"gradient flow: {net}")
+        ax.legend()
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+    return fig
