@@ -130,11 +130,10 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     # derives the same initial models, so client c's chunk IS the stack row
     key = jax.random.key(run.seed)
     chain, init_key = jax.random.split(key)
-    # commit the chain to the mesh (replicated) so each chunk size compiles
-    # once, not twice (uncommitted-then-committed key shardings)
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    chain = jax.device_put(chain, NamedSharding(mesh, P()))
+    # NOTE: unlike FederatedTrainer, the chain is NOT device_put to a
+    # committed sharding here — a multi-controller mesh is not fully
+    # addressable from one process, so device_put would raise.  Cost: each
+    # chunk size may compile twice (uncommitted then committed key).
     one = init_models(init_key, spec, cfg)
     models_g = from_local_chunk(mesh, add_axis(one))
 
