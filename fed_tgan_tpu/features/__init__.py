@@ -1,4 +1,4 @@
-from fed_tgan_tpu.features.bgm import ColumnGMM, fit_column_gmm
+from fed_tgan_tpu.features.bgm import ColumnGMM, fit_column_gmm, fit_column_gmms
 from fed_tgan_tpu.features.transformer import ModeNormalizer
 from fed_tgan_tpu.features.zoo import (
     BGMTransformer,
@@ -17,4 +17,5 @@ __all__ = [
     "MinMaxTransformer",
     "ModeNormalizer",
     "fit_column_gmm",
+    "fit_column_gmms",
 ]
