@@ -38,6 +38,13 @@ class ColumnGMM:
     weights: np.ndarray
     active: np.ndarray
     _sk: Optional[object] = field(default=None, repr=False, compare=False)
+    # variational posterior extras (jax backend): with these present,
+    # predict_proba evaluates the same expected-log-prob E-step sklearn uses
+    # instead of the plain-Gaussian approximation
+    mean_precision: Optional[np.ndarray] = None
+    dof: Optional[np.ndarray] = None
+    stick_a: Optional[np.ndarray] = None
+    stick_b: Optional[np.ndarray] = None
 
     @property
     def n_components(self) -> int:
@@ -52,11 +59,37 @@ class ColumnGMM:
         x = np.asarray(x, dtype=np.float64).reshape(-1)
         if self._sk is not None:
             return self._sk.predict_proba(x.reshape(-1, 1))
+        if self.mean_precision is not None:
+            return self._variational_proba(x)
         log_w = np.log(np.maximum(self.weights, 1e-300))
         z = (x[:, None] - self.means[None, :]) / self.stds[None, :]
         log_p = log_w[None, :] - 0.5 * z**2 - np.log(self.stds)[None, :]
         log_p -= log_p.max(axis=1, keepdims=True)
         p = np.exp(log_p)
+        return p / p.sum(axis=1, keepdims=True)
+
+    def _variational_proba(self, x: np.ndarray) -> np.ndarray:
+        """sklearn's BGM E-step (1-D) from the stored posterior parameters —
+        the same formula bgm_jax's fit iterates, so jax-backend transforms
+        assign modes exactly as the fit's final responsibilities would."""
+        from scipy.special import digamma
+
+        cov = self.stds**2
+        prec = 1.0 / cov
+        log_gauss = -0.5 * (
+            np.log(2.0 * np.pi) - np.log(prec)[None, :]
+            + (x[:, None] - self.means[None, :]) ** 2 * prec[None, :]
+        ) - 0.5 * np.log(self.dof)[None, :]
+        log_lambda = np.log(2.0) + digamma(0.5 * self.dof)
+        log_prob = log_gauss + 0.5 * (log_lambda - 1.0 / self.mean_precision)[None, :]
+        a, b = self.stick_a, self.stick_b
+        dsum = digamma(a + b)
+        log_w = digamma(a) - dsum + np.concatenate(
+            [[0.0], np.cumsum(digamma(b) - dsum)[:-1]]
+        )
+        wlp = log_prob + log_w[None, :]
+        wlp -= wlp.max(axis=1, keepdims=True)
+        p = np.exp(wlp)
         return p / p.sum(axis=1, keepdims=True)
 
     def sample(self, n: int, rng: np.random.Generator | None = None) -> np.ndarray:
@@ -67,20 +100,31 @@ class ColumnGMM:
         return rng.normal(self.means[comp], self.stds[comp])
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "means": self.means.tolist(),
             "stds": self.stds.tolist(),
             "weights": self.weights.tolist(),
             "active": self.active.tolist(),
         }
+        for extra in ("mean_precision", "dof", "stick_a", "stick_b"):
+            v = getattr(self, extra)
+            if v is not None:
+                d[extra] = np.asarray(v).tolist()
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "ColumnGMM":
+        extras = {
+            extra: np.asarray(d[extra], dtype=np.float64)
+            for extra in ("mean_precision", "dof", "stick_a", "stick_b")
+            if extra in d
+        }
         return cls(
             means=np.asarray(d["means"], dtype=np.float64),
             stds=np.asarray(d["stds"], dtype=np.float64),
             weights=np.asarray(d["weights"], dtype=np.float64),
             active=np.asarray(d["active"], dtype=bool),
+            **extras,
         )
 
     @classmethod

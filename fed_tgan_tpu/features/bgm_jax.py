@@ -127,7 +127,7 @@ def _fit_batch(x, mask, *, n_components, max_iter, reg_covar, wc_prior):
     )
     weights = frac * sticks
     weights = weights / weights.sum()
-    return means, jnp.sqrt(cov), weights
+    return means, jnp.sqrt(cov), weights, mean_prec, dof, a, b
 
 
 def fit_columns_jax(
@@ -182,9 +182,10 @@ def fit_columns_jax(
             )
         )
     )
-    means, stds, weights = (np.asarray(r, dtype=np.float64) for r in fit(
-        jnp.asarray(xs), jnp.asarray(masks)
-    ))
+    means, stds, weights, mean_prec, dof, stick_a, stick_b = (
+        np.asarray(r, dtype=np.float64)
+        for r in fit(jnp.asarray(xs), jnp.asarray(masks))
+    )
     out = []
     for i in range(len(cols)):
         w = weights[i]
@@ -194,6 +195,12 @@ def fit_columns_jax(
                 stds=np.maximum(stds[i], 1e-9),
                 weights=w,
                 active=w > eps,
+                # posterior extras: predict_proba then evaluates the exact
+                # variational E-step instead of the Gaussian approximation
+                mean_precision=mean_prec[i],
+                dof=dof[i],
+                stick_a=stick_a[i],
+                stick_b=stick_b[i],
             )
         )
     return out

@@ -71,6 +71,32 @@ def test_jax_backend_tiny_column_falls_back():
     assert np.isfinite(g.means).all() and (g.stds > 0).all()
 
 
+def test_jax_backend_variational_posterior_roundtrip():
+    """predict_proba on a jax-fitted GMM uses the stored variational
+    posterior (mean_precision/dof/sticks) and must survive dict round-trips
+    (the init protocol ships GMMs as dicts between hosts)."""
+    from fed_tgan_tpu.features.bgm import ColumnGMM
+
+    rng = np.random.default_rng(3)
+    x = np.concatenate([rng.normal(-4, 0.5, 700), rng.normal(4, 0.5, 700)])
+    g = fit_column_gmm(x, backend="jax")
+    assert g.mean_precision is not None and g.dof is not None
+
+    p = g.predict_proba(np.asarray([-4.0, 4.0]))
+    assert p.shape == (2, g.n_components)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-6)
+    # each probe point must load onto a mode centered near it
+    for row, center in zip(p, (-4.0, 4.0)):
+        k = int(np.argmax(row))
+        assert abs(g.means[k] - center) < 0.5
+        assert row[k] > 0.9
+
+    g2 = ColumnGMM.from_dict(g.to_dict())
+    np.testing.assert_allclose(
+        g2.predict_proba(x[:50]), g.predict_proba(x[:50]), atol=1e-9
+    )
+
+
 def test_jax_backend_constant_column():
     g = fit_column_gmm(np.full(500, 7.25), backend="jax")
     assert np.isfinite(g.means).all() and np.isfinite(g.stds).all()
