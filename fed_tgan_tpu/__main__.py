@@ -1,0 +1,7 @@
+"""``python -m fed_tgan_tpu`` — the CLI entry point."""
+
+import sys
+
+from fed_tgan_tpu.cli import main
+
+sys.exit(main())

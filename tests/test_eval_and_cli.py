@@ -185,6 +185,21 @@ def test_cli_reference_exact_flags_parse():
     assert a.date_format == ["when=YYYY-MM-DD"]
 
 
+def test_module_aliases_reach_the_cli():
+    """`python -m fed_tgan_tpu.distributed` (the reference's launch module,
+    package name swapped) and `python -m fed_tgan_tpu` both hit the CLI."""
+    for mod in ("fed_tgan_tpu.distributed", "fed_tgan_tpu"):
+        proc = subprocess.run(
+            [sys.executable, "-m", mod, "--help"],
+            capture_output=True,
+            text=True,
+            cwd="/root/repo",
+            timeout=120,
+        )
+        assert proc.returncode == 0, (mod, proc.stderr[-500:])
+        assert "-world_size" in proc.stdout and "-datapath" in proc.stdout
+
+
 def test_cli_nonzero_rank_exits_cleanly():
     proc = subprocess.run(
         [sys.executable, "-m", "fed_tgan_tpu.cli", "-rank", "1"],
