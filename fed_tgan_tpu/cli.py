@@ -91,6 +91,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=500)
     p.add_argument("--embedding-dim", type=int, default=128)
     p.add_argument("--sample-rows", type=int, default=40000)
+    p.add_argument("--monitor-every", type=int, default=0,
+                   help="rounds between on-device Avg_JSD/Avg_WD probes "
+                        "(two scalars of host traffic; 0 = off); written to "
+                        "<out-dir>/monitor_similarity.csv")
     p.add_argument("--sample-every", type=int, default=1,
                    help="epochs between synthetic snapshots; 0 = only at end")
     p.add_argument("--out-dir", type=str, default=".")
@@ -507,9 +511,49 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     def save_due(e: int) -> bool:
         return bool(args.save_every) and (e + 1) % args.save_every == 0
 
+    def monitor_due(e: int) -> bool:
+        return bool(args.monitor_every) and e % args.monitor_every == 0
+
+    monitor = None
+    monitor_rows = []
+    if args.monitor_every:
+        if not hasattr(trainer, "_global_model"):
+            print("note: --monitor-every is not supported for this trainer; ignoring")
+        elif frames is None:
+            print(
+                "note: --monitor-every needs the training data (resumed run "
+                "without a readable --datapath); ignoring"
+            )
+        else:
+            from fed_tgan_tpu.train.monitor import SimilarityMonitor
+
+            real = pd.concat(frames) if len(frames) > 1 else frames[0]
+            if init.global_meta.date_info:
+                # meta columns are the split parts; normalize the raw frame
+                # the same way ingestion did
+                from fed_tgan_tpu.data.dates import split_date_columns
+
+                real = split_date_columns(
+                    real, dict(init.global_meta.date_info), []
+                )
+            monitor = SimilarityMonitor(
+                init.global_meta, init.encoders, real, seed=args.seed
+            )
+
+    def mon_due(e: int) -> bool:
+        return monitor is not None and monitor_due(e)
+
     def hook(e, tr):
         if snapshot_due(e):
             snapshot(e, tr)
+        if mon_due(e):
+            m = monitor.evaluate(tr, seed=args.seed + e)
+            monitor_rows.append([e, m["avg_jsd"], m["avg_wd"]])
+            if not args.quiet:
+                print(
+                    f"round {e}: Avg_JSD={m['avg_jsd']:.4f} "
+                    f"Avg_WD={m['avg_wd']:.4f} (on-device monitor)"
+                )
         if save_due(e):
             from fed_tgan_tpu.runtime.checkpoint import save_federated
 
@@ -517,7 +561,7 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
 
     # --epochs is the TOTAL round budget; a resumed run does the remainder
     remaining = max(0, args.epochs - trainer.completed_epochs)
-    use_hook = bool(args.sample_every or args.save_every)
+    use_hook = bool(args.sample_every or args.save_every or monitor is not None)
     fit_kwargs = {}
     if use_hook and hasattr(trainer, "_epoch_fn_for"):
         # tell the trainer exactly which rounds the hook acts on, so the
@@ -525,13 +569,22 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
         start = trainer.completed_epochs
         fit_kwargs["hook_epochs"] = [
             e for e in range(start, start + remaining)
-            if snapshot_due(e) or save_due(e)
+            if snapshot_due(e) or save_due(e) or mon_due(e)
         ]
     trainer.fit(remaining, log_every=0 if args.quiet else max(1, remaining // 10),
                 sample_hook=hook if use_hook else None, **fit_kwargs)
     last_epoch = trainer.completed_epochs - 1
     if args.sample_every == 0 and last_epoch >= 0:
         snapshot(last_epoch, trainer)
+    if monitor_rows:
+        # append so a resumed run extends (not truncates) the quality history
+        mon_path = os.path.join(args.out_dir, "monitor_similarity.csv")
+        new_file = not os.path.exists(mon_path)
+        with open(mon_path, "a") as f:
+            w = csv.writer(f)
+            if new_file:
+                w.writerow(["Epoch_No.", "Avg_JSD", "Avg_WD"])
+            w.writerows(monitor_rows)
 
     # final checkpoint, unless the in-hook save already wrote this round
     if args.save_every and trainer.completed_epochs % args.save_every != 0:
