@@ -35,37 +35,63 @@ def decode_matrix(
     path (Transform.inverse leaves integer continuous columns as floats);
     ``True`` additionally casts integer columns like decode_train_data does.
     """
-    df = pd.DataFrame(np.asarray(data), columns=meta.column_names)
-
+    data = np.asarray(data)
     cat_names = meta.categorical_columns
     assert len(cat_names) == len(encoders), (len(cat_names), len(encoders))
-    for name, enc in zip(cat_names, encoders):
-        df[name] = enc.inverse_transform(df[name].to_numpy().astype(int))
-
+    enc_by_name = dict(zip(cat_names, encoders))
     cont_names = set(meta.continuous_columns)
-    for name in df.columns:
-        if name in meta.non_negative_columns:
-            x = np.exp(df[name].astype(float).to_numpy()) - 1.0
-            x = np.where(x < 0, np.ceil(x), x)
-            if (x == -1).any():
-                vals = pd.Series(x, index=df.index, dtype=object)
-                vals[x == -1] = MISSING_TOKEN
-                df[name] = vals
+    nonneg = set(meta.non_negative_columns)
+
+    # build every column first, then construct the frame ONCE — incremental
+    # df[name] = ... assignments dominate decode wall-clock (pandas
+    # sanitizes/re-blocks per column)
+    date_parts: set = set()
+    if meta.date_info:
+        from fed_tgan_tpu.data.dates import part_columns
+
+        for column, fmt in meta.date_info.items():
+            date_parts.update(part_columns(column, fmt))
+
+    cols: dict[str, np.ndarray] = {}
+    for i, name in enumerate(meta.column_names):
+        x = data[:, i]
+        if name in enc_by_name:
+            vals = enc_by_name[name].inverse_transform(x.astype(int))
+            # decoded categories may hold the missing token -> ' '; date
+            # part columns keep it — join_date_columns detects missing rows
+            # by the token, and the post-join replace maps the leftovers
+            if name not in date_parts and (vals == MISSING_TOKEN).any():
+                vals = vals.copy()
+                vals[vals == MISSING_TOKEN] = " "
+            cols[name] = vals
+        elif name in nonneg:
+            y = np.exp(x.astype(float)) - 1.0
+            y = np.where(y < 0, np.ceil(y), y)
+            if (y == -1).any():
+                vals = y.astype(object)
+                vals[y == -1] = " "
+                cols[name] = vals
             else:
                 # keep the numeric dtype: identical CSV output, and the
                 # frame stays on the fast (pyarrow) snapshot-writer path
-                df[name] = x
+                cols[name] = y
         elif name in cont_names:
-            x = df[name].astype(float).to_numpy()
-            if (x == MISSING_CONTINUOUS).any():
-                vals = pd.Series(x, index=df.index, dtype=object)
-                vals[x == MISSING_CONTINUOUS] = MISSING_TOKEN
-                df[name] = vals
+            y = x.astype(float)
+            if (y == MISSING_CONTINUOUS).any():
+                vals = y.astype(object)
+                vals[y == MISSING_CONTINUOUS] = " "
+                cols[name] = vals
+            else:
+                cols[name] = y
+        else:
+            cols[name] = x
+
+    df = pd.DataFrame(cols, columns=meta.column_names)
 
     if meta.date_info:
         df = join_date_columns(df, meta.date_info)
-
-    df = df.replace(MISSING_TOKEN, " ")
+        # date rejoin may surface the missing token for empty part rows
+        df = df.replace(MISSING_TOKEN, " ")
 
     if round_integers:
         for name in meta.integer_columns:
