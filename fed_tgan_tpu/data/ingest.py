@@ -18,14 +18,12 @@ import numpy as np
 import pandas as pd
 
 from fed_tgan_tpu.data.constants import (
-    CATEGORICAL,
-    CONTINUOUS,
     MISSING_CONTINUOUS,
     MISSING_TOKEN,
 )
 from fed_tgan_tpu.data.dates import split_date_columns
 from fed_tgan_tpu.data.encoders import CategoryEncoder
-from fed_tgan_tpu.data.schema import ColumnMeta, TableMeta
+from fed_tgan_tpu.data.schema import TableMeta
 
 
 def infer_integer_columns(df: pd.DataFrame) -> list[str]:

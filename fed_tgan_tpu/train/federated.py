@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +34,6 @@ from fed_tgan_tpu.parallel.fedavg import replicate_local, weighted_average
 from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, client_mesh, clients_per_device
 from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
 from fed_tgan_tpu.train.steps import (
-    ModelBundle,
     SampleProgramCache,
     TrainConfig,
     init_models,

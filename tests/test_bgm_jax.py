@@ -7,7 +7,6 @@ because active-mode counts set the model's output dims.
 """
 
 import numpy as np
-import pytest
 
 from fed_tgan_tpu.features.bgm import fit_column_gmm, fit_column_gmms
 

@@ -1,7 +1,6 @@
 """On-device similarity monitor vs the host (reference-formula) eval."""
 
 import numpy as np
-import pandas as pd
 import pytest
 
 from fed_tgan_tpu.data.ingest import TablePreprocessor
