@@ -120,14 +120,19 @@ def test_cli_save_and_resume(tmp_path, toy_frame):
         "--quiet",
     ]
     first = subprocess.run(
-        base + ["--epochs", "1"],
+        base + ["--epochs", "1", "--monitor-every", "1"],
         capture_output=True, text=True, env=env, cwd="/root/repo", timeout=600,
     )
     assert first.returncode == 0, first.stderr[-3000:]
     assert (tmp_path / "checkpoint" / "host.pkl").exists()
+    mon_csv = tmp_path / "monitor_similarity.csv"
+    assert mon_csv.exists()
+    mon_lines_before = mon_csv.read_text().count("\n")
 
     # resume with MINIMAL flags: the run identity (name "toy", config) must
-    # come from the checkpoint, not be re-derived from CLI defaults
+    # come from the checkpoint, not be re-derived from CLI defaults.
+    # --monitor-every without a readable datapath must be IGNORED with a
+    # note, not crash, and must not truncate the existing monitor CSV.
     second = subprocess.run(
         [
             sys.executable, "-m", "fed_tgan_tpu.cli",
@@ -138,6 +143,7 @@ def test_cli_save_and_resume(tmp_path, toy_frame):
             "--n-virtual-devices", "4",
             "--save-every", "1",
             "--save-model",
+            "--monitor-every", "1",
             "--quiet",
         ],
         capture_output=True, text=True, env=env, cwd="/root/repo", timeout=600,
@@ -148,6 +154,10 @@ def test_cli_save_and_resume(tmp_path, toy_frame):
     result = tmp_path / "toy_result"
     assert (result / "toy_synthesis_epoch_1.csv").exists()
     assert (result / "toy_synthesis_epoch_2.csv").exists()
+    # the resumed run noted (not crashed on) the unusable monitor request
+    # and left the first run's monitor history intact
+    assert "monitor-every" in second.stdout
+    assert mon_csv.read_text().count("\n") == mon_lines_before
     # the sampling artifact loads and samples
     from fed_tgan_tpu.runtime.checkpoint import load_synthesizer
 

@@ -169,3 +169,21 @@ def test_nonfinite_guard(fed_init, capsys):
 
     with _pytest.raises(FloatingPointError):
         tr._check_finite(bad, first_epoch=10, mode="raise")
+
+
+def test_small_shard_rejected(toy_frame, toy_spec):
+    """A shard below batch_size would silently train 0 steps in the
+    reference (distributed.py:304); here it must raise with guidance."""
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    frames = shard_dataframe(toy_frame.head(80), 2, "iid", seed=0)  # 40 rows each
+    clients = [TablePreprocessor(frame=f, name="toy", **toy_spec) for f in frames]
+    init = federated_initialize(clients, seed=0)
+    big_batch = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                            batch_size=100, pac=4)
+    with pytest.raises(ValueError, match="fewer than batch_size"):
+        FederatedTrainer(init, config=big_batch, seed=0)
