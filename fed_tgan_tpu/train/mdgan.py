@@ -204,10 +204,17 @@ def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, 
         # per-client mean over the steps it actually ran
         steps_f = jnp.maximum(steps_i.astype(jnp.float32), 1.0)
         metrics = jax.tree.map(lambda m: m.sum(axis=0) / steps_f, metrics)
+        # one replicated divergence bool (see make_federated_epoch): the host
+        # fetches this single scalar instead of every metric array per epoch
+        finite = jnp.stack(
+            [jnp.isfinite(m).all() for m in jax.tree.leaves(metrics)]
+        ).all()
+        all_finite = jax.lax.pmin(finite.astype(jnp.int32), CLIENTS_AXIS) > 0
         return (
             GeneratorBundle(g_params, g_state, g_opt),
             DiscriminatorBundle(d_params_k, d_opt_k),
             metrics,
+            all_finite,
         )
 
     rep, shd = P(), P(CLIENTS_AXIS)
@@ -215,7 +222,7 @@ def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, 
         epoch_local,
         mesh=mesh,
         in_specs=(rep, shd, shd, shd, shd, shd, rep),
-        out_specs=(rep, shd, shd),
+        out_specs=(rep, shd, shd, rep),
         check_vma=False,  # G-side outputs are made device-invariant by psum
     )
     return jax.jit(fn)
@@ -292,13 +299,19 @@ class MDGANTrainer(RoundBookkeeping):
         for _ in range(epochs):
             t0 = time.time()
             self._key, ekey = jax.random.split(self._key)
-            gen, disc, metrics = self._epoch_fn(gen, disc, data, cond, rows, steps, ekey)
+            gen, disc, metrics, finite = self._epoch_fn(
+                gen, disc, data, cond, rows, steps, ekey
+            )
             jax.block_until_ready(gen)
             self.gen, self.disc = gen, disc
             e = self.completed_epochs
-            self._check_finite(
-                jax.tree.map(lambda x: np.asarray(x)[None], metrics), e, on_nonfinite
-            )
+            # single-scalar divergence check; full metric arrays cross to
+            # host only on the failure path (to name the bad round)
+            if on_nonfinite != "ignore" and not bool(finite):
+                self._check_finite(
+                    jax.tree.map(lambda x: np.asarray(x)[None], metrics),
+                    e, on_nonfinite,
+                )
             self._finish_round(time.time() - t0, e, sample_hook)
             if log_every and e % log_every == 0:
                 m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
