@@ -99,6 +99,17 @@ def build_client_stacks(init: FederatedInit, cfg: TrainConfig, spec: SegmentSpec
     return cond_stack, rows_stack, data_stack, steps, server_cond
 
 
+def all_finite_flag(metrics) -> jnp.ndarray:
+    """Replicated scalar: True iff every metric leaf is finite on every
+    client (a diverged client poisons the psum, so pmin over the axis).
+    Shared by both training engines so the host fetches ONE bool per device
+    call instead of every metric array."""
+    finite = jnp.stack(
+        [jnp.isfinite(m).all() for m in jax.tree.leaves(metrics)]
+    ).all()
+    return jax.lax.pmin(finite.astype(jnp.int32), CLIENTS_AXIS) > 0
+
+
 def make_federated_epoch(
     spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, k: int,
     rounds: int = 1,
@@ -173,12 +184,7 @@ def make_federated_epoch(
         (models, key), metrics = jax.lax.scan(
             round_body, (models, key), None, length=rounds
         )
-        finite = jnp.stack(
-            [jnp.isfinite(m).all() for m in jax.tree.leaves(metrics)]
-        ).all()
-        # every client's verdict matters (a diverged client poisons the psum)
-        all_finite = jax.lax.pmin(finite.astype(jnp.int32), CLIENTS_AXIS) > 0
-        return models, metrics, key, all_finite
+        return models, metrics, key, all_finite_flag(metrics)
 
     sharded = P(CLIENTS_AXIS)
     fn = jax.shard_map(

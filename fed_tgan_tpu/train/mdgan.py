@@ -49,7 +49,11 @@ from fed_tgan_tpu.models.ctgan import discriminator_apply, generator_apply
 from fed_tgan_tpu.models.losses import gradient_penalty
 from fed_tgan_tpu.ops.segments import SegmentSpec, apply_activate, cond_loss
 from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, client_mesh, clients_per_device
-from fed_tgan_tpu.train.federated import RoundBookkeeping, build_client_stacks
+from fed_tgan_tpu.train.federated import (
+    RoundBookkeeping,
+    all_finite_flag,
+    build_client_stacks,
+)
 from fed_tgan_tpu.train.steps import (
     SampleProgramCache,
     TrainConfig,
@@ -78,7 +82,7 @@ def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, 
 
     Returned fn signature:
       (gen: GeneratorBundle [replicated], disc: DiscriminatorBundle [sharded],
-       data, cond, rows, steps, key) -> (gen, disc, metrics)
+       data, cond, rows, steps, key) -> (gen, disc, metrics, all_finite)
     """
     opt_g, opt_d = make_optimizers(cfg)
     B = cfg.batch_size
@@ -204,17 +208,11 @@ def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, 
         # per-client mean over the steps it actually ran
         steps_f = jnp.maximum(steps_i.astype(jnp.float32), 1.0)
         metrics = jax.tree.map(lambda m: m.sum(axis=0) / steps_f, metrics)
-        # one replicated divergence bool (see make_federated_epoch): the host
-        # fetches this single scalar instead of every metric array per epoch
-        finite = jnp.stack(
-            [jnp.isfinite(m).all() for m in jax.tree.leaves(metrics)]
-        ).all()
-        all_finite = jax.lax.pmin(finite.astype(jnp.int32), CLIENTS_AXIS) > 0
         return (
             GeneratorBundle(g_params, g_state, g_opt),
             DiscriminatorBundle(d_params_k, d_opt_k),
             metrics,
-            all_finite,
+            all_finite_flag(metrics),
         )
 
     rep, shd = P(), P(CLIENTS_AXIS)
