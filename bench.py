@@ -85,24 +85,33 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True):
     return df, init, trainer
 
 
-def bench_round() -> dict:
-    import numpy as np
+def bench_round(rounds: int = 8) -> dict:
+    """Seconds per round of the real server loop: every round runs the
+    clients' local steps + weighted FedAvg and snapshots 40k rows to a CSV,
+    exactly like the reference server (distributed.py:785-829).  The
+    snapshot's transfer/decode/write overlap the next round's training
+    (SnapshotWriter), as they do in the CLI path — the measured value is
+    total wall-clock of ``rounds`` rounds divided by ``rounds``."""
+    import os
+    import tempfile
 
-    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.train.snapshots import SnapshotWriter
 
     _, init, trainer = _setup()
-
-    def run_round(seed: int) -> float:
-        t0 = time.time()
-        trainer.fit(1)
-        decoded = trainer.sample(40000, seed=seed)
-        decode_matrix(decoded, init.global_meta, init.encoders)
-        return time.time() - t0
-
-    run_round(1)  # compile warmup (rounds=1 program + sample/decode programs)
-    run_round(2)  # second warmup: first post-warmup call may re-specialize
-    times = [run_round(3 + i) for i in range(5)]
-    value = float(np.median(times))
+    with tempfile.TemporaryDirectory() as td:
+        writer = SnapshotWriter(
+            init.global_meta, init.encoders,
+            lambda e: os.path.join(td, f"snapshot_{e}.csv"),
+        )
+        with writer:
+            # warmup: compiles the rounds=1 epoch program + sample/decode
+            # programs and touches the whole transfer/decode/write path
+            trainer.fit(2, sample_hook=writer)
+            writer.drain()
+            t0 = time.time()
+            trainer.fit(rounds, sample_hook=writer)
+            writer.drain()
+            value = (time.time() - t0) / rounds
     return {
         "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)",
         "value": round(value, 4),
@@ -119,55 +128,30 @@ def bench_full500(
 ) -> dict:
     """The reference README's full demo: 500 epochs, snapshot CSV per epoch.
 
-    Each round's 40k-row sample + decode happen synchronously (the device
-    sync is the round's cost floor); only the pure-host CSV WRITE of round i
-    overlaps round i+1's training — IO overlap, training trajectory
-    untouched.
+    Each round's snapshot (device->host transfer, decode, CSV write)
+    overlaps the next round's training via SnapshotWriter — IO/transfer
+    overlap only, training trajectory untouched.
     """
-    import concurrent.futures as cf
-    import os
-
-    from fed_tgan_tpu.data.csvio import write_csv
-    from fed_tgan_tpu.data.decode import decode_matrix
     from fed_tgan_tpu.eval.similarity import statistical_similarity
+    from fed_tgan_tpu.train.snapshots import SnapshotWriter, result_path_fn
 
     if epochs < 1:
         raise ValueError("full500 workload needs epochs >= 1")
     t_start = time.time()
     df, init, trainer = _setup(n_clients=n_clients, weighted=weighted)
+    t_init = time.time() - t_start
 
-    result_dir = os.path.join(out_dir, "Intrusion_result")
-    os.makedirs(result_dir, exist_ok=True)
-    last_raw = {}
-    pending = []
-
-    with cf.ThreadPoolExecutor(max_workers=1) as pool:
-
-        def snapshot(epoch: int, tr) -> None:
-            decoded = tr.sample(40000, seed=epoch)
-            raw = decode_matrix(decoded, init.global_meta, init.encoders)
-            while len(pending) > 1:  # backpressure: one write in flight
-                pending.pop(0).result()
-            pending.append(
-                pool.submit(
-                    write_csv,
-                    raw,
-                    os.path.join(
-                        result_dir, f"Intrusion_synthesis_epoch_{epoch}.csv"
-                    ),
-                )
-            )
-            last_raw["df"] = raw
-
-        trainer.fit(epochs, sample_hook=snapshot)
-        for fut in pending:
-            fut.result()
+    with SnapshotWriter(
+        init.global_meta, init.encoders, result_path_fn(out_dir, "Intrusion")
+    ) as writer:
+        trainer.fit(epochs, sample_hook=writer)
+        last_raw = writer.drain()
     trainer.write_timing(out_dir)
     total = time.time() - t_start
 
     real = df[init.global_meta.column_names]
     avg_jsd, avg_wd, _ = statistical_similarity(
-        real, last_raw["df"], init.global_meta.categorical_columns
+        real, last_raw, init.global_meta.categorical_columns
     )
     suffix = "" if weighted else "(uniform)"
     return {
@@ -175,6 +159,7 @@ def bench_full500(
         "value": round(total, 2),
         "unit": "s",
         "vs_baseline": round(epochs * BASELINE_EPOCH_SECONDS / total, 2),
+        "init_seconds": round(t_init, 2),
         "final_avg_jsd": round(float(avg_jsd), 4),
         "final_avg_wd": round(float(avg_wd), 4),
     }
@@ -194,16 +179,16 @@ def main() -> int:
     args = ap.parse_args()
     tag = _ensure_responsive_backend()
     # persistent compile cache: repeat bench runs (driver runs one per
-    # round) skip the one-time XLA compiles entirely
+    # round) skip the one-time XLA compiles entirely.  Machine-scoped — a
+    # cache built on another box poisons lookups (see runtime/compile_cache)
     import os
 
-    import jax
+    from fed_tgan_tpu.runtime.compile_cache import enable_persistent_cache
 
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".bench_jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    enable_persistent_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_jax_cache")
+    )
     if args.workload == "round":
         out = bench_round()
     else:

@@ -484,12 +484,9 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
 def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     import pandas as pd
 
-    from fed_tgan_tpu.data.csvio import write_csv
-    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.train.snapshots import SnapshotWriter, result_path_fn
 
-    result_dir = os.path.join(args.out_dir, f"{name}_result")
     models_dir = os.path.join(args.out_dir, "models")
-    os.makedirs(result_dir, exist_ok=True)
     os.makedirs(models_dir, exist_ok=True)
 
     init.global_meta.dump_json(os.path.join(models_dir, f"{name}.json"))
@@ -498,12 +495,12 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             encoder_artifact(init.global_meta.categorical_columns, init.encoders), f
         )
 
-    def snapshot(epoch: int, tr) -> None:
-        decoded = tr.sample(args.sample_rows, seed=args.seed + epoch)
-        raw = decode_matrix(decoded, init.global_meta, init.encoders)
-        write_csv(
-            raw, os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv")
-        )
+    # snapshot transfer/decode/CSV-write overlap the next round's training
+    snapshot_path = result_path_fn(args.out_dir, name)
+    snapshot = SnapshotWriter(
+        init.global_meta, init.encoders, snapshot_path,
+        rows=args.sample_rows, seed=args.seed,
+    )
 
     def snapshot_due(e: int) -> bool:
         return bool(args.sample_every) and e % args.sample_every == 0
@@ -571,11 +568,13 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             e for e in range(start, start + remaining)
             if snapshot_due(e) or save_due(e) or mon_due(e)
         ]
-    trainer.fit(remaining, log_every=0 if args.quiet else max(1, remaining // 10),
-                sample_hook=hook if use_hook else None, **fit_kwargs)
-    last_epoch = trainer.completed_epochs - 1
-    if args.sample_every == 0 and last_epoch >= 0:
-        snapshot(last_epoch, trainer)
+    with snapshot:  # waits for in-flight snapshot CSVs, re-raises errors
+        trainer.fit(remaining,
+                    log_every=0 if args.quiet else max(1, remaining // 10),
+                    sample_hook=hook if use_hook else None, **fit_kwargs)
+        last_epoch = trainer.completed_epochs - 1
+        if args.sample_every == 0 and last_epoch >= 0:
+            snapshot(last_epoch, trainer)
     if monitor_rows:
         # append so a resumed run extends (not truncates) the quality history
         mon_path = os.path.join(args.out_dir, "monitor_similarity.csv")
@@ -609,9 +608,7 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             last_snap = (last_epoch // args.sample_every) * args.sample_every
         else:
             last_snap = last_epoch
-        fake = pd.read_csv(
-            os.path.join(result_dir, f"{name}_synthesis_epoch_{last_snap}.csv")
-        )
+        fake = pd.read_csv(snapshot_path(last_snap))
         # compare on the columns actually synthesized (the selected schema)
         full = pd.concat(frames)[fake.columns.tolist()]
         avg_jsd, avg_wd, _ = statistical_similarity(

@@ -209,7 +209,11 @@ class RoundBookkeeping:
     running, so a checkpoint taken inside the hook (cli --save-every) always
     sees a consistent trainer.  Like the reference, the per-round timestamp
     covers the whole round: local steps + aggregation + snapshot/distribution
-    (reference Server/dtds/distributed.py:796,824)."""
+    (reference Server/dtds/distributed.py:796,824).  With a pipelined hook
+    (train.snapshots.SnapshotWriter) the ``distribution`` phase records only
+    the dispatch; the transfer/decode/write cost it hides shows up in the
+    NEXT rounds' ``train_aggregate`` times, so cumulative wall-clock stays
+    honest."""
 
     def _init_bookkeeping(self) -> None:
         self.epoch_times: list[float] = []
@@ -476,3 +480,15 @@ class FederatedTrainer(RoundBookkeeping):
             params_g, state_g, self.server_cond, n, jax.random.key(seed + 29)
         )
         return self._assemble(parts)
+
+    def sample_async(self, n: int, seed: int = 0):
+        """Dispatch ``sample(n, seed)``'s device work now; return a zero-arg
+        finisher producing the identical result.  Lets a snapshot's transfer
+        and host decode overlap the next round's training (the sampled
+        params are immutable device arrays, so the trajectory is
+        untouched)."""
+        params_g, state_g = self._global_model()
+        finish = self._decoded_cache.sample_async(
+            params_g, state_g, self.server_cond, n, jax.random.key(seed + 29)
+        )
+        return lambda: self._assemble(finish())

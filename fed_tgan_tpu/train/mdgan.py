@@ -336,6 +336,14 @@ class MDGANTrainer(RoundBookkeeping):
         )
         return self._assemble(parts)
 
+    def sample_async(self, n: int, seed: int = 0):
+        """See ``FederatedTrainer.sample_async`` — same contract."""
+        finish = self._decoded_cache.sample_async(
+            self.gen.params, self.gen.state, self.server_cond, n,
+            jax.random.key(seed + 29),
+        )
+        return lambda: self._assemble(finish())
+
     def save_time_stamp(self, out_dir: str = ".") -> None:
         import os
 

@@ -260,20 +260,28 @@ class SampleProgramCache:
             )
         return self._programs[n_steps]
 
-    def sample(self, params_g, state_g, cond: CondSampler, n: int, key):
-        """Sample n rows; result mirrors the program output (array or pytree
-        of arrays — e.g. the packed decode's {"cont", "disc"} dict), with
-        chunk results concatenated and trimmed to n rows per leaf."""
-        import numpy as np
-
+    def _chunk_plan(self, n: int) -> list[tuple[int, int]]:
+        """(start_step, n_steps) per chunk covering ceil(n/batch) steps."""
         total_steps = -(-n // self.cfg.batch_size)
-        out, pending, start = [], [], 0
+        plan, start = [], 0
         while start < total_steps:
             remaining = total_steps - start
             if remaining >= self.max_chunk_steps:
                 steps = self.max_chunk_steps
             else:
                 steps = min(-(-remaining // 16) * 16, self.max_chunk_steps)
+            plan.append((start, steps))
+            start += steps
+        return plan
+
+    def sample(self, params_g, state_g, cond: CondSampler, n: int, key):
+        """Sample n rows; result mirrors the program output (array or pytree
+        of arrays — e.g. the packed decode's {"cont", "disc"} dict), with
+        chunk results concatenated and trimmed to n rows per leaf."""
+        import numpy as np
+
+        out, pending = [], []
+        for start, steps in self._chunk_plan(n):
             # double-buffered: dispatch is async so chunk i+1 runs on device
             # while chunk i transfers to host, but at most 2 chunk buffers
             # are ever live — generation stays memory-bounded no matter how
@@ -283,6 +291,35 @@ class SampleProgramCache:
             pending.append(chunk)
             if len(pending) == 2:
                 out.append(jax.tree.map(np.asarray, pending.pop(0)))
-            start += steps
         out.extend(jax.tree.map(np.asarray, p) for p in pending)
         return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0)[:n], *out)
+
+    def fits_async(self, n: int) -> bool:
+        """Whether ``sample_async(n)`` stays within the memory footprint of
+        ``sample()``'s double-buffering (at most 2 chunk buffers live)."""
+        return n <= 2 * self.max_chunk_steps * self.cfg.batch_size
+
+    def sample_async(self, params_g, state_g, cond: CondSampler, n: int, key):
+        """Dispatch all generation chunks now; finish the transfer later.
+
+        Returns a zero-arg callable producing exactly ``sample()``'s result.
+        Every chunk program is dispatched and its device->host copy started
+        before returning, so the caller can queue MORE device work (e.g. the
+        next training round) that overlaps with the transfer; the returned
+        finisher blocks only until the copies land.  All chunk buffers are
+        live at once (no double-buffer bound) — right for snapshot-sized
+        requests; use ``sample()`` for requests far above max_chunk_steps.
+        """
+        import numpy as np
+
+        chunks = []
+        for start, steps in self._chunk_plan(n):
+            chunk = self._program(steps)(params_g, state_g, cond, key, start)
+            jax.tree.map(lambda c: c.copy_to_host_async(), chunk)
+            chunks.append(chunk)
+
+        def finish():
+            out = [jax.tree.map(np.asarray, c) for c in chunks]
+            return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0)[:n], *out)
+
+        return finish

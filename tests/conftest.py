@@ -20,11 +20,12 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the suite's wall-clock is dominated by XLA
 # compiles of near-identical tiny programs (every test builds its own jit
 # closures).  The disk cache dedupes them within a run and across runs —
-# including the driver's repeated `pytest` invocations.
-_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# including the driver's repeated `pytest` invocations.  Machine-scoped: an
+# entry built on another box fails its CPU-feature check on every lookup
+# (see runtime/compile_cache.py), which is slower than no cache at all.
+from fed_tgan_tpu.runtime.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache(os.path.join(os.path.dirname(__file__), ".jax_cache"))
 
 import numpy as np  # noqa: E402
 import pandas as pd  # noqa: E402
