@@ -427,7 +427,14 @@ class FederatedTrainer(RoundBookkeeping):
             # pulled only on the failure path to name the bad round.  State
             # (models AND the already-advanced key chain) is committed BEFORE
             # any raise so a checkpoint taken by an error handler stays
-            # consistent.
+            # consistent.  Starting the scalar's copy at dispatch time means
+            # bool(finite) below finds the value already en route instead of
+            # paying a fresh host<->device round trip after the chunk
+            # completes (~70 ms on a tunneled chip).
+            try:
+                finite.copy_to_host_async()
+            except AttributeError:
+                pass  # non-jax scalar (e.g. a test double)
             ok = on_nonfinite == "ignore" or bool(finite)
             # epoch_times feeds timestamp_experiment.csv — must measure the
             # chunk's real wall-clock, not async dispatch latency
@@ -480,6 +487,11 @@ class FederatedTrainer(RoundBookkeeping):
             params_g, state_g, self.server_cond, n, jax.random.key(seed + 29)
         )
         return self._assemble(parts)
+
+    def fits_async(self, n: int) -> bool:
+        """Whether ``sample_async(n)`` stays within ``sample()``'s
+        double-buffered memory footprint (SnapshotWriter checks this)."""
+        return self._decoded_cache.fits_async(n)
 
     def sample_async(self, n: int, seed: int = 0):
         """Dispatch ``sample(n, seed)``'s device work now; return a zero-arg

@@ -300,6 +300,10 @@ class MDGANTrainer(RoundBookkeeping):
             gen, disc, metrics, finite = self._epoch_fn(
                 gen, disc, data, cond, rows, steps, ekey
             )
+            try:  # scalar arrives with the program, not a round trip later
+                finite.copy_to_host_async()
+            except AttributeError:
+                pass
             jax.block_until_ready(gen)
             self.gen, self.disc = gen, disc
             e = self.completed_epochs
@@ -335,6 +339,10 @@ class MDGANTrainer(RoundBookkeeping):
             jax.random.key(seed + 29),
         )
         return self._assemble(parts)
+
+    def fits_async(self, n: int) -> bool:
+        """See ``FederatedTrainer.fits_async`` — same contract."""
+        return self._decoded_cache.fits_async(n)
 
     def sample_async(self, n: int, seed: int = 0):
         """See ``FederatedTrainer.sample_async`` — same contract."""

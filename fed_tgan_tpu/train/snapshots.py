@@ -59,13 +59,16 @@ class SnapshotWriter:
         self._last = None
 
     def __call__(self, epoch: int, trainer) -> None:
+        # backpressure BEFORE dispatching, so at most max_pending snapshots'
+        # device buffers are ever live (also surfaces worker errors near the
+        # round that caused them)
+        while len(self._pending) >= self.max_pending:
+            self._last = self._pending.pop(0).result()
         if self._use_async(trainer):
             finish = trainer.sample_async(self.rows, seed=self.seed + epoch)
         else:  # no async path / huge request: sample now, write async
             decoded = trainer.sample(self.rows, seed=self.seed + epoch)
             finish = lambda: decoded  # noqa: E731
-        while len(self._pending) >= self.max_pending:
-            self._last = self._pending.pop(0).result()
         self._pending.append(self._pool.submit(self._finish, epoch, finish))
 
     def _use_async(self, trainer) -> bool:
@@ -74,10 +77,11 @@ class SnapshotWriter:
         synchronous ``sample()`` when the request is too large — or when the
         trainer doesn't expose enough to decide (bounded path is the safe
         default)."""
-        if not hasattr(trainer, "sample_async"):
-            return False
-        cache = getattr(trainer, "_decoded_cache", None)
-        return cache is not None and cache.fits_async(self.rows)
+        return (
+            hasattr(trainer, "sample_async")
+            and hasattr(trainer, "fits_async")
+            and trainer.fits_async(self.rows)
+        )
 
     def _finish(self, epoch: int, finish):
         raw = decode_matrix(finish(), self.meta, self.encoders)

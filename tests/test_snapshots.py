@@ -67,6 +67,13 @@ def test_snapshot_writer_large_request_uses_bounded_path(trainer):
     )
     assert not huge._use_async(trainer)
 
+    # a trainer exposing sample_async without the memory-bound introspection
+    # must get the safe (bounded, synchronous-sample) path
+    class Opaque:
+        sample_async = staticmethod(lambda n, seed=0: (lambda: None))
+
+    assert not small._use_async(Opaque())
+
 
 def test_snapshot_writer_error_propagates(trainer, tmp_path):
     init = trainer.init
