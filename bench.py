@@ -62,7 +62,8 @@ def _ensure_responsive_backend(timeout_s: int = 120) -> str:
     return "(cpu-fallback)"
 
 
-def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True):
+def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
+           bgm_backend: str = "sklearn"):
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -80,12 +81,14 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True):
         TablePreprocessor(frame=f, name="Intrusion", selected_columns=selected, **kwargs)
         for f in frames
     ]
-    init = federated_initialize(clients, seed=seed, weighted=weighted)
+    init = federated_initialize(
+        clients, seed=seed, weighted=weighted, backend=bgm_backend
+    )
     trainer = FederatedTrainer(init, config=TrainConfig(), seed=seed)
     return df, init, trainer
 
 
-def bench_round(rounds: int = 8) -> dict:
+def bench_round(rounds: int = 8, bgm_backend: str = "sklearn") -> dict:
     """Seconds per round of the real server loop: every round runs the
     clients' local steps + weighted FedAvg and snapshots 40k rows to a CSV,
     exactly like the reference server (distributed.py:785-829).  The
@@ -97,7 +100,7 @@ def bench_round(rounds: int = 8) -> dict:
 
     from fed_tgan_tpu.train.snapshots import SnapshotWriter
 
-    _, init, trainer = _setup()
+    _, init, trainer = _setup(bgm_backend=bgm_backend)
     with tempfile.TemporaryDirectory() as td:
         writer = SnapshotWriter(
             init.global_meta, init.encoders,
@@ -125,6 +128,7 @@ def bench_full500(
     out_dir: str = "bench_full500_out",
     n_clients: int = 2,
     weighted: bool = True,
+    bgm_backend: str = "sklearn",
 ) -> dict:
     """The reference README's full demo: 500 epochs, snapshot CSV per epoch.
 
@@ -138,7 +142,9 @@ def bench_full500(
     if epochs < 1:
         raise ValueError("full500 workload needs epochs >= 1")
     t_start = time.time()
-    df, init, trainer = _setup(n_clients=n_clients, weighted=weighted)
+    df, init, trainer = _setup(
+        n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend
+    )
     t_init = time.time() - t_start
 
     with SnapshotWriter(
@@ -176,6 +182,11 @@ def main() -> int:
     ap.add_argument("--uniform", action="store_true",
                     help="uniform FedAvg instead of similarity-weighted "
                          "(BASELINE.md config 2)")
+    ap.add_argument("--bgm-backend", choices=["sklearn", "jax"],
+                    default="sklearn",
+                    help="init-time GMM fitting: sklearn (reference-exact "
+                         "estimator, default) or the TPU-native vmapped "
+                         "variational-DP program (faster init)")
     args = ap.parse_args()
     tag = _ensure_responsive_backend()
     # persistent compile cache: repeat bench runs (driver runs one per
@@ -190,11 +201,14 @@ def main() -> int:
                      ".bench_jax_cache")
     )
     if args.workload == "round":
-        out = bench_round()
+        out = bench_round(bgm_backend=args.bgm_backend)
     else:
         out = bench_full500(
-            args.epochs, n_clients=args.clients, weighted=not args.uniform
+            args.epochs, n_clients=args.clients, weighted=not args.uniform,
+            bgm_backend=args.bgm_backend,
         )
+    if args.bgm_backend != "sklearn":
+        out["metric"] += f"({args.bgm_backend}-bgm)"
     out["metric"] += tag
     print(json.dumps(out))
     return 0
