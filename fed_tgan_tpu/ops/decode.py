@@ -87,23 +87,14 @@ def make_device_decode_packed(columns: Sequence):
       the two blocks back into original column order; output is identical
       to ``make_device_decode``'s (then cast to float64).
     """
-    cont_pos, disc_pos, max_code, min_code = [], [], 0, 0
+    cont_pos, disc_pos = [], []
     for i, col in enumerate(columns):
         if isinstance(col, ContinuousColumn):
             cont_pos.append(i)
         else:
             assert isinstance(col, DiscreteColumn)
             disc_pos.append(i)
-            if col.size:
-                max_code = max(max_code, int(np.max(col.codes)))
-                # fit()-path codes are raw column values and may be negative
-                min_code = min(min_code, int(np.min(col.codes)))
-    if -128 <= min_code and max_code <= 127:
-        int_dtype = jnp.int8
-    elif -32768 <= min_code and max_code <= 32767:
-        int_dtype = jnp.int16
-    else:
-        int_dtype = jnp.int32
+    int_dtype = _disc_int_dtype(columns)
     full = make_device_decode(columns)  # reuse the per-column plan/semantics
     n_cols = len(columns)
     cont_idx = np.asarray(cont_pos, dtype=np.int32)
@@ -120,6 +111,110 @@ def make_device_decode_packed(columns: Sequence):
         }
 
     return decode, _make_assemble(cont_idx, disc_idx, n_cols)
+
+
+U_SCALE = 32767  # int16 quantization of the clipped tanh output u in [-1, 1]
+
+
+def _disc_int_dtype(columns: Sequence):
+    """Smallest signed int dtype holding every discrete column's codes
+    (fit()-path codes are raw column values and may be negative)."""
+    max_code, min_code = 0, 0
+    for col in columns:
+        if isinstance(col, DiscreteColumn) and col.size:
+            max_code = max(max_code, int(np.max(col.codes)))
+            min_code = min(min_code, int(np.min(col.codes)))
+    if -128 <= min_code and max_code <= 127:
+        return jnp.int8
+    if -32768 <= min_code and max_code <= 32767:
+        return jnp.int16
+    return jnp.int32
+
+
+def make_device_decode_packed16(columns: Sequence):
+    """Transfer-minimal variant of ``make_device_decode_packed``: continuous
+    columns ship as (int16 quantized u, int8 active-mode index) and the
+    mode denormalization ``u * 4 sigma_k + mu_k`` happens on HOST in float64.
+
+    3 bytes/continuous value instead of 4 — on a tunneled device the
+    snapshot D2H transfer is the round's floor, so this buys ~20% of the
+    continuous block.  Quantization error is <= 4 sigma / 32767 per value
+    (~1e-4 of a mode's std), far below any reported metric precision; use
+    ``make_device_decode_packed`` where bit-exactness with the on-device
+    f32 decode matters (e.g. multihost receivers that rebuild ``assemble``
+    from TableMeta alone — the mu/sigma tables here live in the closure).
+    """
+    cont_pos, disc_pos = [], []
+    means_pad, stds_pad = [], []
+    plan = []  # (kind, start, n_active, codes) per column, in table order
+    st = 0
+    max_modes = 1
+    for i, col in enumerate(columns):
+        if isinstance(col, ContinuousColumn):
+            active = np.flatnonzero(col.gmm.active)
+            cont_pos.append(i)
+            means_pad.append(np.asarray(col.gmm.means[active], dtype=np.float64))
+            stds_pad.append(np.asarray(col.gmm.stds[active], dtype=np.float64))
+            max_modes = max(max_modes, len(active))
+            plan.append(("cont", st, len(active), None))
+            st += 1 + len(active)
+        else:
+            assert isinstance(col, DiscreteColumn)
+            disc_pos.append(i)
+            plan.append(("disc", st, col.size, np.asarray(col.codes, dtype=np.int32)))
+            st += col.size
+    if max_modes > 127:
+        raise ValueError(
+            f"int8 mode index supports <= 127 active GMM modes, got {max_modes} "
+            "(use make_device_decode_packed for such a transformer)"
+        )
+    total_dim = st
+    n_cols = len(columns)
+    cont_idx = np.asarray(cont_pos, dtype=np.int32)
+    disc_idx = np.asarray(disc_pos, dtype=np.int32)
+    mu = np.zeros((len(cont_pos), max_modes), dtype=np.float64)
+    sg = np.zeros((len(cont_pos), max_modes), dtype=np.float64)
+    for j, (m, s) in enumerate(zip(means_pad, stds_pad)):
+        mu[j, : len(m)] = m
+        sg[j, : len(s)] = s
+    int_dtype = _disc_int_dtype(columns)
+
+    def decode(encoded: jax.Array) -> dict:
+        assert encoded.shape[-1] == total_dim, (encoded.shape, total_dim)
+        us, ks, ds = [], [], []
+        for kind, start, size, codes in plan:
+            if kind == "cont":
+                u = jnp.clip(encoded[:, start], -1.0, 1.0)
+                us.append(jnp.round(u * U_SCALE).astype(jnp.int16))
+                ks.append(
+                    jnp.argmax(encoded[:, start + 1 : start + 1 + size], axis=1)
+                    .astype(jnp.int8)
+                )
+            else:
+                sel = jnp.argmax(encoded[:, start : start + size], axis=1)
+                ds.append(jnp.asarray(codes)[sel].astype(int_dtype))
+        n = encoded.shape[0]
+        return {
+            "u": jnp.stack(us, axis=1) if us else jnp.zeros((n, 0), jnp.int16),
+            "k": jnp.stack(ks, axis=1) if ks else jnp.zeros((n, 0), jnp.int8),
+            "disc": jnp.stack(ds, axis=1) if ds else jnp.zeros((n, 0), int_dtype),
+        }
+
+    def assemble(parts: dict) -> np.ndarray:
+        u = np.asarray(parts["u"], dtype=np.float64) / U_SCALE
+        k = np.asarray(parts["k"], dtype=np.int64)
+        disc = np.asarray(parts["disc"])
+        n = u.shape[0] if len(cont_pos) else disc.shape[0]
+        out = np.empty((n, n_cols), dtype=np.float64)
+        if len(cont_pos):
+            sig = np.take_along_axis(sg[None, :, :], k[:, :, None], axis=2)[..., 0]
+            m = np.take_along_axis(mu[None, :, :], k[:, :, None], axis=2)[..., 0]
+            out[:, cont_idx] = u * SCALE * sig + m
+        if len(disc_pos):
+            out[:, disc_idx] = disc
+        return out
+
+    return decode, assemble
 
 
 def _make_assemble(cont_idx: np.ndarray, disc_idx: np.ndarray, n_cols: int):

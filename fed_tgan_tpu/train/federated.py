@@ -348,10 +348,10 @@ class FederatedTrainer(RoundBookkeeping):
 
         self._epoch_fns: dict[int, Any] = {}
         self._device_stacks = None  # uploaded once on first fit()
-        from fed_tgan_tpu.ops.decode import make_device_decode_packed
+        from fed_tgan_tpu.ops.decode import make_device_decode_packed16
 
         self._encoded_cache = SampleProgramCache(self.spec, self.cfg)
-        decode_fn, self._assemble = make_device_decode_packed(
+        decode_fn, self._assemble = make_device_decode_packed16(
             init.transformers[0].columns
         )
         self._decoded_cache = SampleProgramCache(
@@ -479,7 +479,7 @@ class FederatedTrainer(RoundBookkeeping):
         """n decoded rows (numeric codes; feed to data.decode for raw CSV).
 
         Generation + inverse transform run as one device program per chunk;
-        only the packed {float32 continuous, int8/16 discrete} blocks cross
+        only the packed {int16 u + int8 mode, int8/16 discrete} blocks cross
         to host (the snapshot transfer is the round's cost floor on a
         tunneled chip), then scatter back to column order here."""
         params_g, state_g = self._global_model()

@@ -3,9 +3,11 @@ import numpy as np
 
 from fed_tgan_tpu.features.transformer import ModeNormalizer
 from fed_tgan_tpu.ops.decode import (
+    SCALE,
     assemble_for_meta,
     make_device_decode,
     make_device_decode_packed,
+    make_device_decode_packed16,
 )
 
 
@@ -48,6 +50,46 @@ def test_packed_decode_int_dtype_tiers():
         assert parts["disc"].dtype == want, (hi, parts["disc"].dtype)
         full = np.asarray(jax.jit(make_device_decode(tf.columns))(enc))
         np.testing.assert_array_equal(assemble(parts), full.astype(np.float64))
+
+
+def test_packed16_decode_within_quantization_error():
+    tf, enc = _fitted()
+    full = np.asarray(jax.jit(make_device_decode(tf.columns))(enc))
+    decode_fn, assemble = make_device_decode_packed16(tf.columns)
+    parts = jax.tree.map(np.asarray, jax.jit(decode_fn)(enc))
+    assert parts["u"].dtype == np.int16
+    assert parts["k"].dtype == np.int8
+    assert parts["disc"].dtype == np.int8
+    out = assemble(parts)
+    assert out.dtype == np.float64
+
+    # discrete codes are exact; continuous within u-quantization of the
+    # selected mode's 4*sigma span
+    np.testing.assert_array_equal(out[:, 1], full[:, 1].astype(np.float64))
+    stds = tf.columns[0].gmm.stds[np.flatnonzero(tf.columns[0].gmm.active)]
+    tol = SCALE * float(stds.max()) / 32767 + 1e-12
+    np.testing.assert_allclose(out[:, 0], full[:, 0], atol=tol)
+
+
+def test_packed16_continuous_only_and_discrete_only():
+    rng = np.random.default_rng(5)
+    from fed_tgan_tpu.features.transformer import ModeNormalizer
+
+    cont = rng.normal(0, 1, 300)[:, None]
+    tf_c = ModeNormalizer(seed=0).fit(cont, categorical_idx=[])
+    enc_c = tf_c.transform(cont, rng=np.random.default_rng(1))
+    dec, asm = make_device_decode_packed16(tf_c.columns)
+    parts = jax.tree.map(np.asarray, jax.jit(dec)(enc_c))
+    assert parts["disc"].shape == (300, 0)
+    assert asm(parts).shape == (300, 1)
+
+    cat = rng.choice([3.0, 7.0], 300)[:, None]
+    tf_d = ModeNormalizer(seed=0).fit(cat, categorical_idx=[0])
+    enc_d = tf_d.transform(cat, rng=np.random.default_rng(1))
+    dec, asm = make_device_decode_packed16(tf_d.columns)
+    parts = jax.tree.map(np.asarray, jax.jit(dec)(enc_d))
+    assert parts["u"].shape == (300, 0)
+    np.testing.assert_array_equal(asm(parts)[:, 0], cat[:, 0])
 
 
 def test_assemble_for_meta_matches_transformer_layout():
