@@ -297,14 +297,61 @@ def _parse_date_formats(items) -> dict:
     return out
 
 
+def _select_backend(args) -> int:
+    """Honor --backend before any jax use; never hang on a wedged tunnel.
+
+    Returns 0 to proceed, nonzero to abort.  ``--backend cpu`` provisions
+    the virtual mesh; otherwise an accelerator that hangs ``jax.devices()``
+    (a wedged tunnel does, indefinitely) is detected with a subprocess probe:
+    auto mode falls back to a virtual CPU mesh with a warning, an explicit
+    ``--backend tpu`` aborts with a clear message instead."""
+    from fed_tgan_tpu.parallel.mesh import (
+        backend_initialized,
+        probe_backend_responsive,
+        provision_virtual_cpu,
+    )
+
+    if args.backend == "cpu":
+        provision_virtual_cpu(args.n_virtual_devices)
+        return 0
+    import jax
+
+    # the config value only reflects config.update; an env-var pin is read
+    # by jax at backend-init time, so consult both
+    platforms = getattr(jax.config, "jax_platforms", None) or os.environ.get(
+        "JAX_PLATFORMS"
+    )
+    if platforms and set(str(platforms).split(",")) <= {"cpu"}:
+        if args.backend == "tpu":
+            print(
+                "--backend tpu requested but this process is pinned to the "
+                f"cpu platform (jax_platforms={platforms!r}, e.g. via "
+                "JAX_PLATFORMS); unset the pin or drop --backend tpu"
+            )
+            return 2
+        return 0  # this process is already CPU-only: no accelerator to probe
+    if backend_initialized():
+        return 0
+    ok, reason = probe_backend_responsive()
+    if ok:
+        return 0
+    if args.backend == "tpu":
+        print(f"accelerator backend unusable ({reason}); aborting "
+              "--backend tpu run — retry later or use --backend cpu")
+        return 3
+    print(f"WARNING: accelerator backend unusable ({reason}); falling back "
+          f"to a virtual CPU mesh ({args.n_virtual_devices} devices)")
+    provision_virtual_cpu(args.n_virtual_devices)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.sample_from:
-        if args.backend == "cpu":  # honor --backend before any jax use
-            from fed_tgan_tpu.parallel.mesh import provision_virtual_cpu
-
-            provision_virtual_cpu(args.n_virtual_devices)
+        rc = _select_backend(args)
+        if rc:
+            return rc
         return _run_sample_from(args)
     if args.rank is not None and args.ip and (args.rank > 0 or args.world_size):
         # reference-style multi-process launch (rank 0 = server, 1..N =
@@ -327,10 +374,9 @@ def main(argv=None) -> int:
 
     import jax
 
-    if args.backend == "cpu":
-        from fed_tgan_tpu.parallel.mesh import provision_virtual_cpu
-
-        provision_virtual_cpu(args.n_virtual_devices)
+    rc = _select_backend(args)
+    if rc:
+        return rc
 
     import numpy as np
     import pandas as pd

@@ -21,6 +21,70 @@ from jax.sharding import Mesh
 CLIENTS_AXIS = "clients"
 
 
+def backend_initialized() -> bool:
+    """True once any JAX backend client exists in this process."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False  # private API moved: assume uninitialized
+
+
+def probe_backend_responsive(timeout_s: int = 120) -> tuple[bool, str]:
+    """Whether ``jax.devices()`` completes in a fresh interpreter.
+
+    A wedged accelerator tunnel hangs ``jax.devices()`` indefinitely (seen
+    on the tunneled TPU transport under sustained load); probing in a
+    SUBPROCESS with a timeout lets callers fall back to a CPU mesh instead
+    of hanging with it.  Only meaningful before this process initializes a
+    backend.
+
+    Returns ``(ok, reason)`` — ``reason`` distinguishes a hang from a fast
+    crash and carries the child's stderr tail so misconfigurations (e.g. a
+    plugin version mismatch) aren't misreported as "unresponsive".
+
+    A successful probe is cached on disk for an hour (keyed by platform
+    selection), so repeated CLI runs on a healthy machine don't pay the
+    backend double-initialization; failures are never cached.
+    """
+    import hashlib
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    key = hashlib.sha256(
+        (os.environ.get("JAX_PLATFORMS", "") + sys.executable).encode()
+    ).hexdigest()[:16]
+    stamp = os.path.join(tempfile.gettempdir(), f".fed_tgan_backend_ok_{key}")
+    try:
+        if time.time() - os.path.getmtime(stamp) < 3600:
+            return True, "cached"
+    except OSError:
+        pass
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"jax.devices() did not return within {timeout_s}s (hung backend)"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return False, "backend probe crashed: " + (" | ".join(tail) or f"rc={proc.returncode}")
+    try:
+        with open(stamp, "w"):
+            pass
+    except OSError:
+        pass
+    return True, ""
+
+
 def provision_virtual_cpu(n_devices: int) -> None:
     """Force an ``n_devices`` virtual CPU platform (the tests/CI recipe).
 
