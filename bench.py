@@ -31,34 +31,24 @@ BASELINE_EPOCH_SECONDS = 24.26  # reference README.md:53 (cumulative @ epoch 0)
 CSV_PATH = "/root/reference/Server/data/raw/Intrusion_test.csv"
 
 
-def _ensure_responsive_backend(timeout_s: int = 120) -> str:
-    """Probe the accelerator in a subprocess; fall back to CPU if wedged.
+def _ensure_responsive_backend() -> str:
+    """Probe the accelerator (shared helper); fall back to CPU if wedged.
 
     The tunneled TPU backend can hang ``jax.devices()`` indefinitely
     (observed after sustained load).  A benchmark that hangs records
     nothing; a CPU-fallback run records a clearly-labeled number instead.
     Returns "" (accelerator fine) or "(cpu-fallback)" to tag the metric.
     """
-    import subprocess
+    from fed_tgan_tpu.parallel.mesh import probe_backend_responsive
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices(); print(d[0].platform)"],
-            text=True, capture_output=True, timeout=timeout_s,
-        )
-        if proc.returncode == 0:
-            plat = proc.stdout.strip().splitlines()[-1]
-            if plat != "cpu":
-                return ""
-            return ""  # already CPU-only environment: nothing to tag
-    except subprocess.TimeoutExpired:
-        pass
+    ok, reason = probe_backend_responsive()
+    if ok:
+        return ""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    print("WARNING: accelerator backend unresponsive; benchmarking on CPU",
-          file=sys.stderr)
+    print(f"WARNING: accelerator backend unusable ({reason}); "
+          "benchmarking on CPU", file=sys.stderr)
     return "(cpu-fallback)"
 
 

@@ -191,6 +191,23 @@ def _run_multihost_init(args) -> int:
     port = args.port or 7788  # reference default port (distributed.py:898)
     train_after = not args.init_only and args.epochs > 0
 
+    if train_after and args.backend != "cpu":
+        # a multihost rank must never silently switch platforms (the world
+        # would disagree on device layout) — probe the accelerator up front
+        # and abort with the diagnosis instead of hanging in jax.distributed
+        from fed_tgan_tpu.parallel.mesh import (
+            backend_initialized,
+            probe_backend_responsive,
+        )
+
+        if not backend_initialized():
+            ok, reason = probe_backend_responsive()
+            if not ok:
+                print(f"rank {args.rank}: accelerator backend unusable "
+                      f"({reason}); aborting multihost launch — fix the "
+                      "accelerator or relaunch every rank with --backend cpu")
+                return 3
+
     def join_mesh(rank: int) -> None:
         from fed_tgan_tpu.parallel.multihost import initialize_multihost
 
@@ -345,6 +362,22 @@ def _select_backend(args) -> int:
     return 0
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compile cache (machine-scoped, see runtime/compile_cache):
+    repeat CLI runs skip the 20-80s one-time compiles of the epoch/sample
+    programs.  Best-effort — an unwritable cache dir must not block a run."""
+    try:
+        from fed_tgan_tpu.runtime.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "fed_tgan_tpu", "xla_cache"
+            )
+        )
+    except Exception as exc:  # pragma: no cover - depends on host setup
+        print(f"note: persistent compile cache disabled ({exc})")
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -352,6 +385,7 @@ def main(argv=None) -> int:
         rc = _select_backend(args)
         if rc:
             return rc
+        _enable_compile_cache()
         return _run_sample_from(args)
     if args.rank is not None and args.ip and (args.rank > 0 or args.world_size):
         # reference-style multi-process launch (rank 0 = server, 1..N =
@@ -377,6 +411,7 @@ def main(argv=None) -> int:
     rc = _select_backend(args)
     if rc:
         return rc
+    _enable_compile_cache()
 
     import numpy as np
     import pandas as pd

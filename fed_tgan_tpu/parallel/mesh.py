@@ -44,9 +44,13 @@ def probe_backend_responsive(timeout_s: int = 120) -> tuple[bool, str]:
     crash and carries the child's stderr tail so misconfigurations (e.g. a
     plugin version mismatch) aren't misreported as "unresponsive".
 
-    A successful probe is cached on disk for an hour (keyed by platform
-    selection), so repeated CLI runs on a healthy machine don't pay the
-    backend double-initialization; failures are never cached.
+    A successful probe is cached on disk for ``cache_s`` seconds (keyed by
+    platform selection) so bursts of CLI runs on a healthy machine don't pay
+    the backend double-initialization.  The cache is a liveness tradeoff —
+    a wedge arriving inside the window hangs the NEXT run like an unprobed
+    one would (the probe is inherently a point-in-time check: even an
+    uncached probe races a wedge arriving right after it).  The window is
+    kept short for that reason; failures are never cached.
     """
     import hashlib
     import os
@@ -55,12 +59,13 @@ def probe_backend_responsive(timeout_s: int = 120) -> tuple[bool, str]:
     import tempfile
     import time
 
+    cache_s = 300
     key = hashlib.sha256(
         (os.environ.get("JAX_PLATFORMS", "") + sys.executable).encode()
     ).hexdigest()[:16]
     stamp = os.path.join(tempfile.gettempdir(), f".fed_tgan_backend_ok_{key}")
     try:
-        if time.time() - os.path.getmtime(stamp) < 3600:
+        if time.time() - os.path.getmtime(stamp) < cache_s:
             return True, "cached"
     except OSError:
         pass
