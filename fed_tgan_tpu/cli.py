@@ -191,7 +191,7 @@ def _run_multihost_init(args) -> int:
     port = args.port or 7788  # reference default port (distributed.py:898)
     train_after = not args.init_only and args.epochs > 0
 
-    if train_after and args.backend != "cpu":
+    if train_after and args.backend != "cpu" and not _cpu_pinned():
         # a multihost rank must never silently switch platforms (the world
         # would disagree on device layout) — probe the accelerator up front
         # and abort with the diagnosis instead of hanging in jax.distributed
@@ -207,6 +207,8 @@ def _run_multihost_init(args) -> int:
                       f"({reason}); aborting multihost launch — fix the "
                       "accelerator or relaunch every rank with --backend cpu")
                 return 3
+    if train_after:
+        _enable_compile_cache()
 
     def join_mesh(rank: int) -> None:
         from fed_tgan_tpu.parallel.multihost import initialize_multihost
@@ -314,6 +316,18 @@ def _parse_date_formats(items) -> dict:
     return out
 
 
+def _cpu_pinned() -> bool:
+    """Whether this process can only ever see the cpu platform.  The config
+    value only reflects ``config.update``; an env-var pin is read by jax at
+    backend-init time, so consult both."""
+    import jax
+
+    platforms = getattr(jax.config, "jax_platforms", None) or os.environ.get(
+        "JAX_PLATFORMS"
+    )
+    return bool(platforms) and set(str(platforms).split(",")) <= {"cpu"}
+
+
 def _select_backend(args) -> int:
     """Honor --backend before any jax use; never hang on a wedged tunnel.
 
@@ -331,19 +345,12 @@ def _select_backend(args) -> int:
     if args.backend == "cpu":
         provision_virtual_cpu(args.n_virtual_devices)
         return 0
-    import jax
-
-    # the config value only reflects config.update; an env-var pin is read
-    # by jax at backend-init time, so consult both
-    platforms = getattr(jax.config, "jax_platforms", None) or os.environ.get(
-        "JAX_PLATFORMS"
-    )
-    if platforms and set(str(platforms).split(",")) <= {"cpu"}:
+    if _cpu_pinned():
         if args.backend == "tpu":
             print(
                 "--backend tpu requested but this process is pinned to the "
-                f"cpu platform (jax_platforms={platforms!r}, e.g. via "
-                "JAX_PLATFORMS); unset the pin or drop --backend tpu"
+                "cpu platform (jax_platforms config or JAX_PLATFORMS env); "
+                "unset the pin or drop --backend tpu"
             )
             return 2
         return 0  # this process is already CPU-only: no accelerator to probe
@@ -367,6 +374,10 @@ def _enable_compile_cache() -> None:
     repeat CLI runs skip the 20-80s one-time compiles of the epoch/sample
     programs.  Best-effort — an unwritable cache dir must not block a run."""
     try:
+        import jax
+
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            return  # host already configured a cache (tests, bench): keep it
         from fed_tgan_tpu.runtime.compile_cache import enable_persistent_cache
 
         enable_persistent_cache(
