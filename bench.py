@@ -11,8 +11,8 @@ train CSV was stripped from the snapshot).  Prints ONE JSON line.
 
 Workloads (--workload):
   round   (default) value = seconds per federated round including the 40k
-          snapshot decode (median of 5 measured rounds, post-compile);
-          vs_baseline = 24.26 / value.
+          snapshot CSV (mean of 8 pipelined rounds of the real server
+          loop, post-compile); vs_baseline = 24.26 / value.
   full500 the reference's de-facto verification run (README.md:44-68):
           500 federated rounds, a 40k-row snapshot CSV written EVERY round
           like the reference server does, then the similarity eval on the
@@ -24,6 +24,7 @@ Workloads (--workload):
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -85,7 +86,6 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn") -> dict:
     snapshot's transfer/decode/write overlap the next round's training
     (SnapshotWriter), as they do in the CLI path — the measured value is
     total wall-clock of ``rounds`` rounds divided by ``rounds``."""
-    import os
     import tempfile
 
     from fed_tgan_tpu.train.snapshots import SnapshotWriter
@@ -182,8 +182,6 @@ def main() -> int:
     # persistent compile cache: repeat bench runs (driver runs one per
     # round) skip the one-time XLA compiles entirely.  Machine-scoped — a
     # cache built on another box poisons lookups (see runtime/compile_cache)
-    import os
-
     from fed_tgan_tpu.runtime.compile_cache import enable_persistent_cache
 
     enable_persistent_cache(

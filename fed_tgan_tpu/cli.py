@@ -191,22 +191,13 @@ def _run_multihost_init(args) -> int:
     port = args.port or 7788  # reference default port (distributed.py:898)
     train_after = not args.init_only and args.epochs > 0
 
-    if train_after and args.backend != "cpu" and not _cpu_pinned():
-        # a multihost rank must never silently switch platforms (the world
-        # would disagree on device layout) — probe the accelerator up front
-        # and abort with the diagnosis instead of hanging in jax.distributed
-        from fed_tgan_tpu.parallel.mesh import (
-            backend_initialized,
-            probe_backend_responsive,
-        )
-
-        if not backend_initialized():
-            ok, reason = probe_backend_responsive()
-            if not ok:
-                print(f"rank {args.rank}: accelerator backend unusable "
-                      f"({reason}); aborting multihost launch — fix the "
-                      "accelerator or relaunch every rank with --backend cpu")
-                return 3
+    if train_after and args.backend != "cpu":
+        # same platform policy as the single-host path, minus the CPU
+        # fallback (initialize_multihost owns cpu provisioning, hence the
+        # backend guard above)
+        rc = _pick_platform(args, cpu_fallback=False, who=f"rank {args.rank}: ")
+        if rc:
+            return rc
     if train_after:
         _enable_compile_cache()
 
@@ -331,11 +322,24 @@ def _cpu_pinned() -> bool:
 def _select_backend(args) -> int:
     """Honor --backend before any jax use; never hang on a wedged tunnel.
 
-    Returns 0 to proceed, nonzero to abort.  ``--backend cpu`` provisions
-    the virtual mesh; otherwise an accelerator that hangs ``jax.devices()``
-    (a wedged tunnel does, indefinitely) is detected with a subprocess probe:
-    auto mode falls back to a virtual CPU mesh with a warning, an explicit
-    ``--backend tpu`` aborts with a clear message instead."""
+    Returns 0 to proceed (with the persistent compile cache enabled),
+    nonzero to abort.  ``--backend cpu`` provisions the virtual mesh;
+    otherwise an accelerator that hangs ``jax.devices()`` (a wedged tunnel
+    does, indefinitely) is detected with a subprocess probe: auto mode falls
+    back to a virtual CPU mesh with a warning, an explicit ``--backend tpu``
+    aborts with a clear message instead."""
+    rc = _pick_platform(args)
+    if rc == 0:
+        _enable_compile_cache()
+    return rc
+
+
+def _pick_platform(args, cpu_fallback: bool = True, who: str = "") -> int:
+    """One platform policy for every launch path.  ``cpu_fallback=False``
+    (multihost ranks) turns the auto-mode CPU fallback into an abort — a
+    rank silently switching platforms would disagree with the rest of the
+    ``jax.distributed`` world on device layout.  ``who`` prefixes messages
+    (e.g. ``"rank 2: "``)."""
     from fed_tgan_tpu.parallel.mesh import (
         backend_initialized,
         probe_backend_responsive,
@@ -348,9 +352,9 @@ def _select_backend(args) -> int:
     if _cpu_pinned():
         if args.backend == "tpu":
             print(
-                "--backend tpu requested but this process is pinned to the "
-                "cpu platform (jax_platforms config or JAX_PLATFORMS env); "
-                "unset the pin or drop --backend tpu"
+                f"{who}--backend tpu requested but this process is pinned "
+                "to the cpu platform (jax_platforms config or JAX_PLATFORMS "
+                "env); unset the pin or drop --backend tpu"
             )
             return 2
         return 0  # this process is already CPU-only: no accelerator to probe
@@ -359,9 +363,12 @@ def _select_backend(args) -> int:
     ok, reason = probe_backend_responsive()
     if ok:
         return 0
-    if args.backend == "tpu":
-        print(f"accelerator backend unusable ({reason}); aborting "
-              "--backend tpu run — retry later or use --backend cpu")
+    if args.backend == "tpu" or not cpu_fallback:
+        hint = ("fix the accelerator or relaunch every rank with "
+                "--backend cpu" if not cpu_fallback
+                else "retry later or use --backend cpu")
+        print(f"{who}accelerator backend unusable ({reason}); "
+              f"aborting — {hint}")
         return 3
     print(f"WARNING: accelerator backend unusable ({reason}); falling back "
           f"to a virtual CPU mesh ({args.n_virtual_devices} devices)")
@@ -396,7 +403,6 @@ def main(argv=None) -> int:
         rc = _select_backend(args)
         if rc:
             return rc
-        _enable_compile_cache()
         return _run_sample_from(args)
     if args.rank is not None and args.ip and (args.rank > 0 or args.world_size):
         # reference-style multi-process launch (rank 0 = server, 1..N =
@@ -422,7 +428,6 @@ def main(argv=None) -> int:
     rc = _select_backend(args)
     if rc:
         return rc
-    _enable_compile_cache()
 
     import numpy as np
     import pandas as pd
