@@ -1,0 +1,89 @@
+"""The CLI's platform policy matrix (_pick_platform), without subprocesses.
+
+Pins the decisions: explicit cpu provisions, cpu-pinned + tpu refuses,
+wedged accelerator falls back (single-host auto) or aborts (multihost /
+explicit tpu).  The probe and provisioning are monkeypatched — the real
+probe behavior is exercised by bench/CLI runs, this locks the POLICY."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from fed_tgan_tpu import cli
+
+
+def _args(backend=None):
+    return SimpleNamespace(backend=backend, n_virtual_devices=4)
+
+
+@pytest.fixture
+def policy(monkeypatch):
+    """Patchable world: records provisioning, controls pin + probe."""
+    state = {"provisioned": 0, "pinned": False, "probe": (True, ""),
+             "initialized": False}
+    import fed_tgan_tpu.parallel.mesh as mesh
+
+    monkeypatch.setattr(
+        mesh, "provision_virtual_cpu",
+        lambda n: state.__setitem__("provisioned", state["provisioned"] + 1),
+    )
+    monkeypatch.setattr(mesh, "backend_initialized",
+                        lambda: state["initialized"])
+    monkeypatch.setattr(mesh, "probe_backend_responsive",
+                        lambda: state["probe"])
+    monkeypatch.setattr(cli, "_cpu_pinned", lambda: state["pinned"])
+    return state
+
+
+def test_explicit_cpu_provisions(policy):
+    assert cli._pick_platform(_args("cpu")) == 0
+    assert policy["provisioned"] == 1
+
+
+def test_pinned_auto_proceeds_without_probe(policy):
+    policy["pinned"] = True
+    policy["probe"] = (False, "should not be called")
+    assert cli._pick_platform(_args(None)) == 0
+    assert policy["provisioned"] == 0
+
+
+def test_pinned_explicit_tpu_refuses(policy, capsys):
+    policy["pinned"] = True
+    assert cli._pick_platform(_args("tpu")) == 2
+    assert "pinned" in capsys.readouterr().out
+
+
+def test_initialized_backend_skips_probe(policy):
+    policy["initialized"] = True
+    policy["probe"] = (False, "should not be called")
+    assert cli._pick_platform(_args(None)) == 0
+
+
+def test_wedge_auto_falls_back_to_cpu(policy, capsys):
+    policy["probe"] = (False, "hung backend")
+    assert cli._pick_platform(_args(None)) == 0
+    assert policy["provisioned"] == 1
+    assert "falling back" in capsys.readouterr().out
+
+
+def test_wedge_explicit_tpu_aborts(policy, capsys):
+    policy["probe"] = (False, "hung backend")
+    assert cli._pick_platform(_args("tpu")) == 3
+    assert policy["provisioned"] == 0
+    out = capsys.readouterr().out
+    assert "unusable" in out and "hung backend" in out
+
+
+def test_wedge_multihost_never_falls_back(policy, capsys):
+    policy["probe"] = (False, "hung backend")
+    rc = cli._pick_platform(_args(None), cpu_fallback=False, who="rank 1: ")
+    assert rc == 3
+    assert policy["provisioned"] == 0
+    out = capsys.readouterr().out
+    assert out.startswith("rank 1: ")
+    assert "--backend cpu" in out
+
+
+def test_healthy_probe_proceeds(policy):
+    assert cli._pick_platform(_args(None)) == 0
+    assert policy["provisioned"] == 0
