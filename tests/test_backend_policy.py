@@ -57,6 +57,7 @@ def test_initialized_backend_skips_probe(policy):
     policy["initialized"] = True
     policy["probe"] = (False, "should not be called")
     assert cli._pick_platform(_args(None)) == 0
+    assert policy["provisioned"] == 0  # probe failure would have fallen back
 
 
 def test_wedge_auto_falls_back_to_cpu(policy, capsys):

@@ -1,0 +1,37 @@
+"""Machine-scoped persistent compile cache (runtime/compile_cache)."""
+
+import os
+
+import jax
+
+from fed_tgan_tpu.runtime.compile_cache import (
+    _machine_fingerprint,
+    enable_persistent_cache,
+)
+
+
+def test_cache_dir_is_machine_scoped_and_sweeps_flat_entries(tmp_path):
+    base = tmp_path / "cache"
+    base.mkdir()
+    # stale pre-fingerprint layout: files at the top level
+    (base / "jit__f-deadbeef-cache").write_bytes(b"stale")
+    other = base / "otherbox123"
+    other.mkdir()
+    (other / "entry").write_bytes(b"kept")  # other machines' subdirs stay
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        got = enable_persistent_cache(str(base))
+        assert got == str(base / _machine_fingerprint())
+        assert jax.config.jax_compilation_cache_dir == got
+        assert not (base / "jit__f-deadbeef-cache").exists()
+        assert (other / "entry").exists()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_fingerprint_is_stable_and_filesystem_safe():
+    fp = _machine_fingerprint()
+    assert fp == _machine_fingerprint()
+    assert len(fp) == 12 and fp.isalnum()
+    assert os.sep not in fp
