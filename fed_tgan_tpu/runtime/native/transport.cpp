@@ -109,12 +109,22 @@ struct Endpoint {
 
 extern "C" {
 
+// errno of the most recent failed create on this thread, for diagnostics
+// (a bare null handle told callers nothing about WHY the bind failed)
+static thread_local int g_last_errno = 0;
+
+int ft_last_errno() { return g_last_errno; }
+
 // ---- server ----------------------------------------------------------------
 
 // Create a listening endpoint on port; returns handle (>0 pointer) or null.
 void* ft_server_create(int port) {
+  g_last_errno = 0;  // never report a stale, unrelated failure
   int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return nullptr;
+  if (fd < 0) {
+    g_last_errno = errno;
+    return nullptr;
+  }
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
@@ -123,6 +133,7 @@ void* ft_server_create(int port) {
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       listen(fd, 64) != 0) {
+    g_last_errno = errno;
     close(fd);
     return nullptr;
   }
@@ -174,15 +185,22 @@ int ft_server_accept(void* handle, int n_clients, int timeout_ms) {
 // so client and server start order doesn't matter (the reference's
 // rendezvous behavior).
 void* ft_client_create(const char* host, int port, int rank, int timeout_ms) {
+  g_last_errno = 0;  // never report a stale, unrelated failure
   int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return nullptr;
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    g_last_errno = EINVAL;  // host is not a numeric IPv4 address
+    return nullptr;
+  }
 
   while (true) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) return nullptr;
+    if (fd < 0) {
+      g_last_errno = errno;
+      return nullptr;
+    }
     set_common_opts(fd);  // O_NONBLOCK first so connect honors the deadline
     int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
     bool ok = rc == 0;
@@ -191,11 +209,13 @@ void* ft_client_create(const char* host, int port, int rank, int timeout_ms) {
         int err = 0;
         socklen_t len = sizeof(err);
         ok = getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) == 0 && err == 0;
+        if (!ok && err) errno = err;  // surface the real connect failure
       }
     }
     if (ok) {
       uint32_t rank_le = htole32(static_cast<uint32_t>(rank));
       if (send_all(fd, reinterpret_cast<uint8_t*>(&rank_le), 4, deadline) != 0) {
+        g_last_errno = errno ? errno : EPIPE;
         close(fd);
         return nullptr;
       }
@@ -203,8 +223,16 @@ void* ft_client_create(const char* host, int port, int rank, int timeout_ms) {
       ep->peers.push_back(fd);
       return ep;
     }
+    int connect_errno = errno;
     close(fd);
-    if (deadline >= 0 && now_ms() >= deadline) return nullptr;
+    if (deadline >= 0 && now_ms() >= deadline) {
+      // EINPROGRESS means the final nonblocking connect was still pending
+      // when the rendezvous deadline hit — report the timeout, not it
+      g_last_errno = (connect_errno && connect_errno != EINPROGRESS)
+                         ? connect_errno
+                         : ETIMEDOUT;
+      return nullptr;
+    }
     usleep(100 * 1000);  // retry rendezvous every 100 ms
   }
 }

@@ -30,6 +30,15 @@ _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 
+def _last_errno_suffix(lib) -> str:
+    """' (strerror)' for the native layer's last create failure, or ''."""
+    try:
+        e = int(lib.ft_last_errno())
+        return f" ({os.strerror(e)})" if e else ""
+    except Exception:
+        return ""
+
+
 def _load_library() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
@@ -46,6 +55,8 @@ def _load_library() -> ctypes.CDLL:
                 capture_output=True,
             )
         lib = ctypes.CDLL(_LIB_PATH)
+        lib.ft_last_errno.restype = ctypes.c_int
+        lib.ft_last_errno.argtypes = []
         lib.ft_server_create.restype = ctypes.c_void_p
         lib.ft_server_create.argtypes = [ctypes.c_int]
         lib.ft_server_accept.restype = ctypes.c_int
@@ -130,7 +141,9 @@ class ServerTransport(_Endpoint):
         lib = _load_library()
         handle = lib.ft_server_create(port)
         if not handle:
-            raise TransportError(f"cannot listen on port {port}")
+            raise TransportError(
+                f"cannot listen on port {port}{_last_errno_suffix(lib)}"
+            )
         super().__init__(handle)
         self.n_clients = n_clients
         rc = lib.ft_server_accept(handle, n_clients, timeout_ms)
@@ -159,7 +172,9 @@ class ClientTransport(_Endpoint):
         lib = _load_library()
         handle = lib.ft_client_create(host.encode(), port, rank, timeout_ms)
         if not handle:
-            raise TransportError(f"cannot reach server at {host}:{port}")
+            raise TransportError(
+                f"cannot reach server at {host}:{port}{_last_errno_suffix(lib)}"
+            )
         super().__init__(handle)
         self.rank = rank
 

@@ -13,7 +13,11 @@ from fed_tgan_tpu.runtime.transport import (
     TransportError,
 )
 
-PORT = 47881
+# PID-derived so a concurrent or earlier-interrupted run's sockets can't
+# collide with this one's fixed ports; kept BELOW Linux's default ephemeral
+# range (32768-60999) so the kernel's own outgoing-port allocation can't
+# race the bind either
+PORT = 20000 + (os.getpid() * 13) % 10000
 
 
 def _run_client(rank, results, port=PORT):
