@@ -54,7 +54,7 @@ def _ensure_responsive_backend() -> str:
 
 
 def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
-           bgm_backend: str = "sklearn"):
+           bgm_backend: str = "sklearn", df=None):
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -64,7 +64,8 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
     from fed_tgan_tpu.train.federated import FederatedTrainer
     from fed_tgan_tpu.train.steps import TrainConfig
 
-    df = pd.read_csv(CSV_PATH)
+    if df is None:
+        df = pd.read_csv(CSV_PATH)
     kwargs = preprocessor_kwargs(INTRUSION)
     selected = kwargs.pop("selected_columns")
     frames = shard_dataframe(df, n_clients, "iid", seed=seed)
@@ -161,17 +162,64 @@ def bench_full500(
     }
 
 
+def bench_utility(epochs: int = 500, n_clients: int = 2,
+                  weighted: bool = True, bgm_backend: str = "sklearn") -> dict:
+    """Driver-reproducible ΔF1: the reference utility_analysis protocol
+    (reference Server/utility_analysis.py:94-119, README.md:67 headline
+    0.0850 at 500 epochs on the FULL training CSV).
+
+    Only the 10,098-row test split survives in this snapshot, so 70% trains
+    the GAN and 30% is held out BEFORE training (rows the generator never
+    saw); LR/DT/RF/MLP are fit on real-vs-synthetic and scored on the
+    holdout.  ΔF1 = real F1 − synthetic F1 averaged over the 4 classifiers
+    (lower is better; negative = synthetic beat real).
+    """
+    import pandas as pd
+
+    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.eval.utility import utility_difference
+
+    t_start = time.time()
+    df = pd.read_csv(CSV_PATH)
+    split = int(len(df) * 0.7)
+    train_df, test_df = df.iloc[:split], df.iloc[split:]
+    _, init, trainer = _setup(
+        n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend,
+        df=train_df,
+    )
+    trainer.fit(epochs)  # hook-free: rounds fuse into device programs
+
+    cols = init.global_meta.column_names
+    real_train = train_df[cols]
+    raw = decode_matrix(
+        trainer.sample(len(real_train), seed=1), init.global_meta, init.encoders
+    )
+    u = utility_difference(
+        real_train, raw, test_df[cols], "class",
+        init.global_meta.categorical_columns,
+    )
+    suffix = "" if weighted else "(uniform)"
+    return {
+        "metric": f"intrusion_{n_clients}client_delta_f1_at_{epochs}{suffix}",
+        "value": round(float(u["delta_f1"]), 4),
+        "unit": "delta_f1(real-synthetic; ref 0.0850 on 10x more data)",
+        "vs_baseline": round(0.0850 - float(u["delta_f1"]), 4),
+        "train_seconds": round(time.time() - t_start, 1),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--workload", choices=["round", "full500"], default="round")
+    ap.add_argument("--workload", choices=["round", "full500", "utility"],
+                    default="round")
     ap.add_argument("--epochs", type=int, default=500,
-                    help="full500 workload: number of rounds")
+                    help="full500/utility workloads: number of rounds")
     ap.add_argument("--clients", type=int, default=2,
-                    help="full500 workload: participants (BASELINE.md configs "
-                         "2/3 use 8)")
+                    help="full500/utility workloads: participants "
+                         "(BASELINE.md configs 2/3 use 8)")
     ap.add_argument("--uniform", action="store_true",
                     help="uniform FedAvg instead of similarity-weighted "
-                         "(BASELINE.md config 2)")
+                         "(BASELINE.md config 2; full500/utility workloads)")
     ap.add_argument("--bgm-backend", choices=["sklearn", "jax"],
                     default="sklearn",
                     help="init-time GMM fitting: sklearn (reference-exact "
@@ -190,6 +238,11 @@ def main() -> int:
     )
     if args.workload == "round":
         out = bench_round(bgm_backend=args.bgm_backend)
+    elif args.workload == "utility":
+        out = bench_utility(
+            args.epochs, n_clients=args.clients, weighted=not args.uniform,
+            bgm_backend=args.bgm_backend,
+        )
     else:
         out = bench_full500(
             args.epochs, n_clients=args.clients, weighted=not args.uniform,
