@@ -51,8 +51,10 @@ def ml_utility(
     ref = ref.drop(columns=[target_column])
 
     scaler = preprocessing.StandardScaler().fit(ref.values)
-    x_train = scaler.transform(x_train)
-    x_test = scaler.transform(x_test)
+    # .values on both sides: fitting on the bare array but transforming a
+    # DataFrame triggers sklearn's feature-names warning on every call
+    x_train = scaler.transform(x_train.values)
+    x_test = scaler.transform(x_test.values)
 
     models = [
         linear_model.LogisticRegression(class_weight="balanced", random_state=RANDOM_STATE),
