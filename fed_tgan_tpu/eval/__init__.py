@@ -1,4 +1,20 @@
-from fed_tgan_tpu.eval.similarity import statistical_similarity
-from fed_tgan_tpu.eval.utility import ml_utility, utility_difference
+"""Evaluation suite: statistical similarity + ML utility.
+
+Lazy re-exports: ``python -m fed_tgan_tpu.eval.utility`` would otherwise
+import the submodule through this package first and trip runpy's
+already-in-sys.modules warning."""
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("utility", "similarity"):  # submodule attribute access
+        return importlib.import_module(f"{__name__}.{name}")
+    if name in ("ml_utility", "utility_difference"):
+        return getattr(importlib.import_module(f"{__name__}.utility"), name)
+    if name == "statistical_similarity":
+        return importlib.import_module(f"{__name__}.similarity").statistical_similarity
+    raise AttributeError(name)
+
 
 __all__ = ["ml_utility", "statistical_similarity", "utility_difference"]

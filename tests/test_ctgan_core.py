@@ -215,7 +215,7 @@ def test_gradient_penalty_matches_torch():
     ti.requires_grad_(True)
     di = _torch_disc_forward(layers, out, ti, pac)
     g = torch.autograd.grad(di, ti, torch.ones_like(di), create_graph=True)[0]
-    want = float((((g.view(-1, pac * 6).norm(2, dim=1) - 1) ** 2).mean() * 10.0))
+    want = float((((g.view(-1, pac * 6).norm(2, dim=1) - 1) ** 2).mean() * 10.0).detach())
     assert got == pytest.approx(want, rel=1e-4)
 
 
