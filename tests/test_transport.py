@@ -106,6 +106,10 @@ def test_distributed_init_matches_in_process(toy_frame, toy_spec):
         assert client_out[rank]["transformer"].output_info == reference.output_info
 
 
+@pytest.mark.slow  # 3 subprocess jax imports ~25s; the slow tier's full
+# multihost TRAINING e2e supersedes this init-only path, and the fast
+# tier still covers the transport (roundtrip test) and the CLI dispatch
+# (test_backend_policy)
 def test_cli_multihost_init_processes(tmp_path):
     """Reference-style launch: rank 0 + two client ranks as separate
     PROCESSES over TCP (reference README.md:10-13), via the CLI."""
