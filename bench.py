@@ -39,12 +39,35 @@ def _ensure_responsive_backend() -> str:
     (observed after sustained load).  A benchmark that hangs records
     nothing; a CPU-fallback run records a clearly-labeled number instead.
     Returns "" (accelerator fine) or "(cpu-fallback)" to tag the metric.
-    """
-    from fed_tgan_tpu.parallel.mesh import probe_backend_responsive
 
-    ok, reason = probe_backend_responsive()
+    This is the one command whose entire purpose is the accelerator
+    number, so a single failed probe must not flip the run to CPU: the
+    probe retries with backoff (~8 min worst case, narrated on stderr)
+    before giving up, and a successful probe is immediately followed by a
+    watchdog-guarded in-process backend touch so a wedge arriving inside
+    the probe cache window aborts loudly instead of hanging the bench.
+    """
+    from fed_tgan_tpu.parallel.mesh import (
+        probe_backend_responsive,
+        touch_backend_with_watchdog,
+    )
+
+    try:
+        attempts = int(os.environ.get("FED_TGAN_BENCH_PROBE_ATTEMPTS", "3"))
+    except ValueError:
+        print("bench: ignoring non-integer FED_TGAN_BENCH_PROBE_ATTEMPTS",
+              file=sys.stderr)
+        attempts = 3
+    ok, reason = probe_backend_responsive(
+        attempts=attempts,
+        backoff_s=60.0,
+        log=lambda msg: print(f"bench: {msg}", file=sys.stderr, flush=True),
+    )
     if ok:
-        return ""
+        # hang -> watchdog aborts with diagnosis; crash -> CPU fallback
+        ok, reason = touch_backend_with_watchdog(timeout_s=180.0, who="bench: ")
+        if ok:
+            return ""
     import jax
 
     jax.config.update("jax_platforms", "cpu")

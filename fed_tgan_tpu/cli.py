@@ -344,6 +344,7 @@ def _pick_platform(args, cpu_fallback: bool = True, who: str = "") -> int:
         backend_initialized,
         probe_backend_responsive,
         provision_virtual_cpu,
+        touch_backend_with_watchdog,
     )
 
     if args.backend == "cpu":
@@ -362,7 +363,14 @@ def _pick_platform(args, cpu_fallback: bool = True, who: str = "") -> int:
         return 0
     ok, reason = probe_backend_responsive()
     if ok:
-        return 0
+        # A positive probe can be a cached stamp predating a fresh wedge;
+        # touch the backend NOW under a watchdog so a hang aborts with the
+        # probe's diagnosis instead of stalling the first real use, and a
+        # crash (chip grabbed between probe and touch) falls through to
+        # the same fallback/abort policy as a failed probe.
+        ok, reason = touch_backend_with_watchdog(timeout_s=180.0, who=who)
+        if ok:
+            return 0
     if args.backend == "tpu" or not cpu_fallback:
         hint = ("fix the accelerator or relaunch every rank with "
                 "--backend cpu" if not cpu_fallback

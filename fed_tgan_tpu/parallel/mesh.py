@@ -31,7 +31,12 @@ def backend_initialized() -> bool:
         return False  # private API moved: assume uninitialized
 
 
-def probe_backend_responsive(timeout_s: int = 120) -> tuple[bool, str]:
+def probe_backend_responsive(
+    timeout_s: int = 120,
+    attempts: int = 1,
+    backoff_s: float = 60.0,
+    log=None,
+) -> tuple[bool, str]:
     """Whether ``jax.devices()`` completes in a fresh interpreter.
 
     A wedged accelerator tunnel hangs ``jax.devices()`` indefinitely (seen
@@ -44,49 +49,161 @@ def probe_backend_responsive(timeout_s: int = 120) -> tuple[bool, str]:
     crash and carries the child's stderr tail so misconfigurations (e.g. a
     plugin version mismatch) aren't misreported as "unresponsive".
 
+    ``attempts`` > 1 retries a failed probe after ``backoff_s`` seconds —
+    for callers (the benchmark) whose entire purpose is the accelerator
+    number, one transient wedge or a probe racing another process holding
+    the chip should not flip the run to CPU permanently.  ``log`` (callable
+    taking a string) narrates each failed attempt so a fallback is
+    self-explaining.
+
     A successful probe is cached on disk for ``cache_s`` seconds (keyed by
-    platform selection) so bursts of CLI runs on a healthy machine don't pay
-    the backend double-initialization.  The cache is a liveness tradeoff —
-    a wedge arriving inside the window hangs the NEXT run like an unprobed
-    one would (the probe is inherently a point-in-time check: even an
-    uncached probe races a wedge arriving right after it).  The window is
+    platform selection and uid) so bursts of CLI runs on a healthy machine
+    don't pay the backend double-initialization.  The cache is a liveness
+    tradeoff — a wedge arriving inside the window hangs the NEXT run like
+    an unprobed one would (the probe is inherently a point-in-time check:
+    even an uncached probe races a wedge arriving right after it); callers
+    close that hole with ``touch_backend_with_watchdog``.  The window is
     kept short for that reason; failures are never cached.
     """
-    import hashlib
     import os
     import subprocess
     import sys
-    import tempfile
     import time
 
     cache_s = 300
-    key = hashlib.sha256(
-        (os.environ.get("JAX_PLATFORMS", "") + sys.executable).encode()
-    ).hexdigest()[:16]
-    stamp = os.path.join(tempfile.gettempdir(), f".fed_tgan_backend_ok_{key}")
+    stamp = _probe_stamp_path()
     try:
-        if time.time() - os.path.getmtime(stamp) < cache_s:
+        st = os.lstat(stamp)  # lstat: never trust a symlinked stamp
+        import stat as _stat
+
+        if (_stat.S_ISREG(st.st_mode) and st.st_uid == os.getuid()
+                and time.time() - st.st_mtime < cache_s):
             return True, "cached"
     except OSError:
         pass
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return False, f"jax.devices() did not return within {timeout_s}s (hung backend)"
-    if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()[-3:]
-        return False, "backend probe crashed: " + (" | ".join(tail) or f"rc={proc.returncode}")
-    try:
-        with open(stamp, "w"):
+    reason = ""
+    for attempt in range(1, max(1, attempts) + 1):
+        if attempt > 1:
+            if log is not None:
+                log(f"backend probe attempt {attempt - 1}/{attempts} failed "
+                    f"({reason}); retrying in {backoff_s:.0f}s")
+            time.sleep(backoff_s)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            reason = (f"jax.devices() did not return within {timeout_s}s "
+                      "(hung backend)")
+            continue
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            reason = ("backend probe crashed: "
+                      + (" | ".join(tail) or f"rc={proc.returncode}"))
+            continue
+        try:
+            fd = os.open(stamp, os.O_WRONLY | os.O_CREAT | os.O_NOFOLLOW,
+                         0o600)
+            os.utime(fd)
+            os.close(fd)
+        except OSError:
             pass
-    except OSError:
-        pass
+        return True, "" if attempt == 1 else f"ok after {attempt} attempts"
+    if attempts > 1:
+        reason += f" (after {attempts} attempts over ~" \
+                  f"{(attempts * timeout_s + (attempts - 1) * backoff_s) / 60:.0f} min)"
+    return False, reason
+
+
+def _probe_stamp_path() -> str:
+    """Path of the positive-probe cache stamp.
+
+    uid in the key + O_NOFOLLOW on create (see caller): on a shared box
+    another user's stale stamp must not vouch for this user's tunnel, nor
+    may a planted symlink at the predictable path redirect the create.
+    """
+    import hashlib
+    import os
+    import sys
+    import tempfile
+
+    key = hashlib.sha256(
+        (os.environ.get("JAX_PLATFORMS", "") + sys.executable
+         + str(os.getuid())).encode()
+    ).hexdigest()[:16]
+    return os.path.join(tempfile.gettempdir(), f".fed_tgan_backend_ok_{key}")
+
+
+def touch_backend_with_watchdog(
+    timeout_s: float = 180.0,
+    who: str = "",
+    _touch=None,
+    _abort=None,
+) -> tuple[bool, str]:
+    """Initialize the accelerator backend NOW, guarded by a watchdog.
+
+    The probe cache means a run can start inside the positive-cache window
+    of a probe that predates a fresh wedge; that run's first real
+    ``jax.devices()`` then hangs exactly like an unprobed one.  Calling
+    this right after platform selection closes the hole: the touch happens
+    immediately, and a watchdog thread aborts the process with the same
+    diagnosis the probe produces if it doesn't complete in ``timeout_s``.
+
+    A touch that CRASHES instead of hanging (e.g. another process grabbed
+    the chip between probe and touch) returns ``(False, reason)`` — the
+    probe-style contract — so callers route it through their normal
+    fallback/abort policy instead of dying on a raw traceback.  A hang
+    cannot return: the watchdog ``os._exit``\\ s (not ``sys.exit``) because
+    the main thread is stuck inside an uninterruptible C extension call —
+    no Python exception can reach it.  Both failure modes invalidate the
+    positive stamp so the next run re-probes for real.
+    ``_touch``/``_abort`` are test seams.
+    """
+    if backend_initialized():
+        return True, ""
+    import os
+    import sys
+    import threading
+
+    done = threading.Event()
+
+    def _drop_stamp() -> None:
+        # invalidate the (now-stale) positive stamp so the NEXT run
+        # re-probes for real and can fall back to CPU gracefully
+        # instead of repeating this failure for the cache window
+        try:
+            os.unlink(_probe_stamp_path())
+        except OSError:
+            pass
+
+    def _watch() -> None:
+        if not done.wait(timeout_s):
+            _drop_stamp()
+            print(
+                f"{who}accelerator backend unusable (jax.devices() did not "
+                f"return within {timeout_s:.0f}s after a positive probe — "
+                "the tunnel likely wedged inside the probe-cache window); "
+                "aborting — retry later or use --backend cpu",
+                file=sys.stderr,
+                flush=True,
+            )
+            (_abort or os._exit)(3)
+
+    watchdog = threading.Thread(target=_watch, daemon=True,
+                                name="backend-touch-watchdog")
+    watchdog.start()
+    try:
+        (jax.devices if _touch is None else _touch)()
+    except Exception as exc:
+        done.set()
+        _drop_stamp()
+        return False, f"backend init crashed after a positive probe: {exc}"
+    finally:
+        done.set()
     return True, ""
 
 

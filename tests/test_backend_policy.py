@@ -31,6 +31,11 @@ def policy(monkeypatch):
                         lambda: state["initialized"])
     monkeypatch.setattr(mesh, "probe_backend_responsive",
                         lambda: state["probe"])
+    def _fake_touch(**kw):
+        state["touched"] = state.get("touched", 0) + 1
+        return True, ""
+
+    monkeypatch.setattr(mesh, "touch_backend_with_watchdog", _fake_touch)
     monkeypatch.setattr(cli, "_cpu_pinned", lambda: state["pinned"])
     return state
 
@@ -88,3 +93,176 @@ def test_wedge_multihost_never_falls_back(policy, capsys):
 def test_healthy_probe_proceeds(policy):
     assert cli._pick_platform(_args(None)) == 0
     assert policy["provisioned"] == 0
+    # a positive probe is immediately followed by the watchdog-guarded
+    # in-process touch (closes the probe-cache wedge window)
+    assert policy.get("touched", 0) == 1
+
+
+def test_watchdog_aborts_on_hung_backend_touch(monkeypatch, tmp_path):
+    """A backend touch that never returns must exit with the probe's
+    diagnosis, not hang — run in a subprocess because the abort path is
+    os._exit (the stuck main thread can't receive an exception).  TMPDIR
+    redirects the stamp into tmp_path so a dev box's real warm stamp is
+    neither clobbered nor raced."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+    import tempfile
+
+    import fed_tgan_tpu.parallel.mesh as mesh
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    stamp = pathlib.Path(mesh._probe_stamp_path())
+    stamp.touch()  # a positive stamp that predates the "wedge"
+    env = dict(os.environ, TMPDIR=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, "-c", (
+            "import time\n"
+            "from fed_tgan_tpu.parallel import mesh\n"
+            "mesh.touch_backend_with_watchdog(\n"
+            "    timeout_s=0.5, who='t: ', _touch=lambda: time.sleep(30))\n"
+            "print('unreachable')\n"
+        )],
+        capture_output=True, text=True, timeout=20, env=env,
+    )
+    assert proc.returncode == 3
+    assert "unreachable" not in proc.stdout
+    assert "t: accelerator backend unusable" in proc.stderr
+    assert "--backend cpu" in proc.stderr
+    # the stale stamp was invalidated so the next run re-probes for real
+    assert not stamp.exists()
+
+
+def test_watchdog_noop_on_fast_touch_and_initialized_backend(monkeypatch):
+    import fed_tgan_tpu.parallel.mesh as mesh
+
+    aborts = []
+    # fast touch: watchdog disarms, no abort even after the timeout window
+    # (timeout generous enough that a descheduled single-core host can't
+    # expire it between start and done.set)
+    assert mesh.touch_backend_with_watchdog(
+        timeout_s=1.5, _touch=lambda: None, _abort=aborts.append) == (True, "")
+    import time
+
+    time.sleep(1.7)
+    assert aborts == []
+    # initialized backend: touch is skipped entirely
+    monkeypatch.setattr(mesh, "backend_initialized", lambda: True)
+    assert mesh.touch_backend_with_watchdog(
+        timeout_s=0.5,
+        _touch=lambda: (_ for _ in ()).throw(AssertionError("touched")),
+    ) == (True, "")
+
+
+def test_watchdog_crashing_touch_returns_probe_style_failure(
+        monkeypatch, tmp_path):
+    """A touch that CRASHES (chip grabbed between probe and touch) must
+    return (False, reason) and drop the stamp, not raise."""
+    import pathlib
+    import tempfile
+
+    import fed_tgan_tpu.parallel.mesh as mesh
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    stamp = pathlib.Path(mesh._probe_stamp_path())
+    stamp.touch()
+
+    def boom():
+        raise RuntimeError("Unable to initialize backend 'axon'")
+
+    ok, reason = mesh.touch_backend_with_watchdog(timeout_s=5.0, _touch=boom)
+    assert not ok
+    assert "crashed after a positive probe" in reason
+    assert "Unable to initialize backend" in reason
+    assert not stamp.exists()
+
+
+def test_crashing_touch_falls_back_via_policy(policy, capsys, monkeypatch):
+    """cli._pick_platform routes a crashed touch through the same
+    fallback/abort policy as a failed probe."""
+    import fed_tgan_tpu.parallel.mesh as mesh
+
+    monkeypatch.setattr(mesh, "touch_backend_with_watchdog",
+                        lambda **kw: (False, "backend init crashed"))
+    assert cli._pick_platform(_args(None)) == 0
+    assert policy["provisioned"] == 1
+    assert "falling back" in capsys.readouterr().out
+    assert cli._pick_platform(_args("tpu")) == 3
+
+
+def test_probe_retries_with_backoff(monkeypatch, tmp_path):
+    """attempts=3 keeps probing through transient failures and narrates
+    each retry; the stamp cache is redirected so no prior success vouches."""
+    import subprocess
+    import tempfile
+    import time
+
+    import fed_tgan_tpu.parallel.mesh as mesh
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    monkeypatch.setattr(time, "sleep", lambda s: None)  # no real backoff
+    calls = {"n": 0}
+
+    def fake_run(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+        return subprocess.CompletedProcess(a, 0, stdout="", stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    logs = []
+    ok, reason = mesh.probe_backend_responsive(
+        timeout_s=1, attempts=3, backoff_s=1.0, log=logs.append)
+    assert ok and "3 attempts" in reason
+    assert calls["n"] == 3
+    assert len(logs) == 2 and "retrying" in logs[0]
+
+    # all attempts fail -> reason says how long was spent trying
+    for p in tmp_path.glob(".fed_tgan_backend_ok_*"):
+        p.unlink()  # drop the success stamp so the cache can't vouch
+    calls["n"] = -100
+    def always_hang(*a, **kw):
+        calls["n"] += 1
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", always_hang)
+    ok, reason = mesh.probe_backend_responsive(
+        timeout_s=1, attempts=3, backoff_s=1.0)
+    assert not ok
+    assert "hung backend" in reason and "3 attempts" in reason
+
+
+def test_probe_stamp_is_uid_scoped_and_nofollow(monkeypatch, tmp_path):
+    """A symlink planted at the stamp path must not be followed on create,
+    and a cached stamp owned by another uid must not vouch."""
+    import subprocess
+    import tempfile
+
+    import pathlib
+
+    import fed_tgan_tpu.parallel.mesh as mesh
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    stamp = pathlib.Path(mesh._probe_stamp_path())
+    victim = tmp_path / "victim"
+    victim.write_text("precious")
+    stamp.symlink_to(victim)
+
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **kw: subprocess.CompletedProcess(a, 0, "", ""))
+    ok, _ = mesh.probe_backend_responsive(timeout_s=1)
+    assert ok
+    assert victim.read_text() == "precious"  # symlink not followed
+    # and the symlinked stamp is not trusted as a cache hit: a fresh call
+    # still probes (we see it because the fake run counts)
+    calls = {"n": 0}
+
+    def counting_run(*a, **kw):
+        calls["n"] += 1
+        return subprocess.CompletedProcess(a, 0, "", "")
+
+    monkeypatch.setattr(subprocess, "run", counting_run)
+    ok, reason = mesh.probe_backend_responsive(timeout_s=1)
+    assert ok and calls["n"] == 1 and reason != "cached"
