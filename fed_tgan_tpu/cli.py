@@ -591,6 +591,29 @@ def _run_sample_from(args) -> int:
     name = os.path.splitext(os.path.basename(meta_path))[0]
     enc_path = os.path.join(models_dir, f"label_encoders_{name}.pickle")
 
+    # meta/encoders are written at training START, the synthesizer at the
+    # END — a later run that crashed (or omitted --save-model) leaves the
+    # newest meta paired with an OLDER run's synthesizer.  Decoding through
+    # mismatched artifacts produces wrong categories or a shape error, so
+    # detect the inversion and say what it means before sampling.
+    try:
+        synth_mtime = max(
+            os.path.getmtime(os.path.join(synth_dir, f))
+            for f in os.listdir(synth_dir)
+        )
+        if os.path.getmtime(meta_path) > synth_mtime:
+            print(
+                "--sample-from WARNING: meta "
+                f"{os.path.basename(meta_path)} is newer than the saved "
+                "synthesizer — the run that wrote it likely never saved a "
+                "model (crashed or ran without --save-model).  Sampling "
+                "proceeds with the OLDER synthesizer; if the schema "
+                "changed between runs this will decode wrong categories "
+                "or fail on shapes."
+            )
+    except (OSError, ValueError):
+        pass  # unreadable/empty synth dir: load_synthesizer will explain
+
     synth = load_synthesizer(synth_dir)
     meta = TableMeta.load_json(meta_path)
     with open(enc_path, "rb") as f:
@@ -703,7 +726,11 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
         return bool(args.monitor_every) and e % args.monitor_every == 0
 
     monitor = None
-    monitor_rows = []
+    # rows are appended + flushed as produced (MonitorLog) so a crash or
+    # kill mid-run keeps the quality history collected so far
+    from fed_tgan_tpu.train.monitor import MonitorLog
+
+    mon_log = MonitorLog(os.path.join(args.out_dir, "monitor_similarity.csv"))
     if args.monitor_every:
         if not hasattr(trainer, "_global_model"):
             print("note: --monitor-every is not supported for this trainer; ignoring")
@@ -736,7 +763,7 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             snapshot(e, tr)
         if mon_due(e):
             m = monitor.evaluate(tr, seed=args.seed + e)
-            monitor_rows.append([e, m["avg_jsd"], m["avg_wd"]])
+            mon_log.append(e, m["avg_jsd"], m["avg_wd"])
             if not args.quiet:
                 print(
                     f"round {e}: Avg_JSD={m['avg_jsd']:.4f} "
@@ -759,22 +786,14 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             e for e in range(start, start + remaining)
             if snapshot_due(e) or save_due(e) or mon_due(e)
         ]
-    with snapshot:  # waits for in-flight snapshot CSVs, re-raises errors
-        trainer.fit(remaining,
-                    log_every=0 if args.quiet else max(1, remaining // 10),
-                    sample_hook=hook if use_hook else None, **fit_kwargs)
-        last_epoch = trainer.completed_epochs - 1
-        if args.sample_every == 0 and last_epoch >= 0:
-            snapshot(last_epoch, trainer)
-    if monitor_rows:
-        # append so a resumed run extends (not truncates) the quality history
-        mon_path = os.path.join(args.out_dir, "monitor_similarity.csv")
-        new_file = not os.path.exists(mon_path)
-        with open(mon_path, "a") as f:
-            w = csv.writer(f)
-            if new_file:
-                w.writerow(["Epoch_No.", "Avg_JSD", "Avg_WD"])
-            w.writerows(monitor_rows)
+    with mon_log:
+        with snapshot:  # waits for in-flight snapshot CSVs, re-raises errors
+            trainer.fit(remaining,
+                        log_every=0 if args.quiet else max(1, remaining // 10),
+                        sample_hook=hook if use_hook else None, **fit_kwargs)
+            last_epoch = trainer.completed_epochs - 1
+            if args.sample_every == 0 and last_epoch >= 0:
+                snapshot(last_epoch, trainer)
 
     # final checkpoint, unless the in-hook save already wrote this round
     if args.save_every and trainer.completed_epochs % args.save_every != 0:

@@ -248,3 +248,20 @@ def assemble_for_meta(meta):
         np.asarray(disc, dtype=np.int32),
         len(meta.column_names),
     )
+
+
+def select_snapshot_decode(columns: Sequence):
+    """The trainers' snapshot decode: quantized packed16 by default,
+    bit-exact packed via ``FED_TGAN_TPU_EXACT_DECODE=1``.
+
+    packed16 quantizes every continuous output (error <= 4 sigma / 32767),
+    so snapshot CSVs are not byte-identical to the exact f32 decode.  The
+    error is far below metric precision, but golden values recorded against
+    the exact path (or users needing bit-stable CSVs across versions) can
+    pin it with the env switch instead of editing trainer code.
+    """
+    import os
+
+    if os.environ.get("FED_TGAN_TPU_EXACT_DECODE", "") == "1":
+        return make_device_decode_packed(columns)
+    return make_device_decode_packed16(columns)

@@ -44,14 +44,32 @@ def enable_persistent_cache(base_dir: str) -> str:
     return cache_dir
 
 
+_SWEEP_MARKER = ".flat_layout_swept"
+
+
+def _looks_like_xla_entry(name: str) -> bool:
+    """XLA persistent-cache entries are ``jit_<fn>-<hex>`` /  long-hex
+    names; anything else in the dir is NOT ours to delete."""
+    import re
+
+    return bool(re.match(r"^jit_", name) or re.fullmatch(r"[0-9a-f]{16,}", name))
+
+
 def _sweep_flat_layout_entries(base_dir: str) -> None:
     """Delete entries from the pre-fingerprint flat layout: they were built
     by whichever machine last held the repo and would sit as dead weight
-    (JAX only reads the fingerprint subdir now)."""
+    (JAX only reads the fingerprint subdir now).  One-time (marker-gated)
+    and restricted to XLA-looking names, so pointing ``base_dir`` at a
+    non-dedicated directory can't silently eat unrelated files."""
+    marker = os.path.join(base_dir, _SWEEP_MARKER)
+    if os.path.exists(marker):
+        return
     try:
         for name in os.listdir(base_dir):
             path = os.path.join(base_dir, name)
-            if os.path.isfile(path):
+            if os.path.isfile(path) and _looks_like_xla_entry(name):
                 os.unlink(path)
+        with open(marker, "w"):
+            pass
     except OSError:
         pass

@@ -348,10 +348,10 @@ class FederatedTrainer(RoundBookkeeping):
 
         self._epoch_fns: dict[int, Any] = {}
         self._device_stacks = None  # uploaded once on first fit()
-        from fed_tgan_tpu.ops.decode import make_device_decode_packed16
+        from fed_tgan_tpu.ops.decode import select_snapshot_decode
 
         self._encoded_cache = SampleProgramCache(self.spec, self.cfg)
-        decode_fn, self._assemble = make_device_decode_packed16(
+        decode_fn, self._assemble = select_snapshot_decode(
             init.transformers[0].columns
         )
         self._decoded_cache = SampleProgramCache(

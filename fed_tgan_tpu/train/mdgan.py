@@ -268,10 +268,10 @@ class MDGANTrainer(RoundBookkeeping):
         self._epoch_fn = make_mdgan_epoch(
             self.spec, self.cfg, self.max_steps, self.mesh, self.k
         )
-        from fed_tgan_tpu.ops.decode import make_device_decode_packed16
+        from fed_tgan_tpu.ops.decode import select_snapshot_decode
 
         self._encoded_cache = SampleProgramCache(self.spec, self.cfg)
-        decode_fn, self._assemble = make_device_decode_packed16(
+        decode_fn, self._assemble = select_snapshot_decode(
             init.transformers[0].columns
         )
         self._decoded_cache = SampleProgramCache(

@@ -152,3 +152,47 @@ class SimilarityMonitor:
             params_g, state_g, trainer.server_cond, jax.random.key(seed + 31)
         )
         return {k: float(v) for k, v in out.items()}
+
+
+class MonitorLog:
+    """Crash-durable CSV sink for per-round monitor rows.
+
+    The reference's similarity history only exists because every epoch's
+    40k-row CSV survives on disk; here the history is two floats per round,
+    so each row is appended AND flushed as it is produced — a crash or
+    kill mid-run keeps everything collected so far.  Append mode lets a
+    resumed run extend (not truncate) the file.  The file is opened lazily
+    on the first row: a run whose monitor never fires creates nothing.
+    """
+
+    HEADER = ["Epoch_No.", "Avg_JSD", "Avg_WD"]
+
+    def __init__(self, path: str):
+        self.path = path
+        self._file = None
+        self._writer = None
+
+    def append(self, epoch: int, avg_jsd: float, avg_wd: float) -> None:
+        import csv
+        import os
+
+        if self._file is None:
+            new_file = not os.path.exists(self.path)
+            self._file = open(self.path, "a", newline="")
+            self._writer = csv.writer(self._file)
+            if new_file:
+                self._writer.writerow(self.HEADER)
+        self._writer.writerow([epoch, avg_jsd, avg_wd])
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+            self._writer = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

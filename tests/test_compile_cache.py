@@ -19,6 +19,9 @@ def test_cache_dir_is_machine_scoped_and_sweeps_flat_entries(tmp_path):
     other.mkdir()
     (other / "entry").write_bytes(b"kept")  # other machines' subdirs stay
 
+    # a non-cache bystander file must survive the sweep
+    (base / "notes.txt").write_text("precious")
+
     before = jax.config.jax_compilation_cache_dir
     try:
         got = enable_persistent_cache(str(base))
@@ -26,6 +29,11 @@ def test_cache_dir_is_machine_scoped_and_sweeps_flat_entries(tmp_path):
         assert jax.config.jax_compilation_cache_dir == got
         assert not (base / "jit__f-deadbeef-cache").exists()
         assert (other / "entry").exists()
+        assert (base / "notes.txt").read_text() == "precious"
+        # the sweep is one-time: a new flat entry after the marker stays
+        (base / "jit__g-feedface-cache").write_bytes(b"new")
+        enable_persistent_cache(str(base))
+        assert (base / "jit__g-feedface-cache").exists()
     finally:
         jax.config.update("jax_compilation_cache_dir", before)
 

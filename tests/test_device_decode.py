@@ -112,3 +112,21 @@ def test_assemble_for_meta_matches_transformer_layout():
     )
     via_meta = assemble_for_meta(meta)(parts)
     np.testing.assert_array_equal(via_meta, assemble(parts))
+
+
+def test_select_snapshot_decode_env_switch(monkeypatch):
+    """FED_TGAN_TPU_EXACT_DECODE=1 routes trainers to the bit-exact packed
+    decode (parts keyed cont/disc); the default stays packed16 (u/k/disc)."""
+    from fed_tgan_tpu.ops.decode import select_snapshot_decode
+
+    tf, enc = _fitted()
+    monkeypatch.delenv("FED_TGAN_TPU_EXACT_DECODE", raising=False)
+    decode_fn, _ = select_snapshot_decode(tf.columns)
+    assert set(jax.jit(decode_fn)(enc)) == {"u", "k", "disc"}
+
+    monkeypatch.setenv("FED_TGAN_TPU_EXACT_DECODE", "1")
+    decode_fn, assemble = select_snapshot_decode(tf.columns)
+    parts = jax.tree.map(np.asarray, jax.jit(decode_fn)(enc))
+    assert set(parts) == {"cont", "disc"}
+    full = np.asarray(jax.jit(make_device_decode(tf.columns))(enc))
+    np.testing.assert_array_equal(assemble(parts), full.astype(np.float64))

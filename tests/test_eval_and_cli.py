@@ -402,3 +402,79 @@ def test_cli_date_column_end_to_end(tmp_path, toy_frame):
     parsed = pd.to_datetime(snap["when"], errors="coerce")
     assert parsed.notna().all(), snap["when"].head().tolist()
     assert parsed.dt.year.between(2010, 2030).all()
+
+
+def test_monitor_log_rows_survive_without_close(tmp_path):
+    """Each appended row is flushed immediately — the history survives a
+    kill mid-run (simulated by reading the file while the writer is still
+    open) — and a reopened log extends instead of truncating."""
+    from fed_tgan_tpu.train.monitor import MonitorLog
+
+    path = tmp_path / "monitor_similarity.csv"
+    log = MonitorLog(str(path))
+    log.append(0, 0.19, 0.08)
+    log.append(1, 0.08, 0.04)
+    # NOT closed: this is what a killed process would leave behind
+    lines = path.read_text().splitlines()
+    assert lines[0] == "Epoch_No.,Avg_JSD,Avg_WD"
+    assert lines[1].startswith("0,") and lines[2].startswith("1,")
+    log.close()
+
+    # resume: append mode, no second header, history extended
+    with MonitorLog(str(path)) as log2:
+        log2.append(2, 0.05, 0.03)
+    lines = path.read_text().splitlines()
+    assert len(lines) == 4 and lines[3].startswith("2,")
+    assert lines.count("Epoch_No.,Avg_JSD,Avg_WD") == 1
+
+    # a run whose monitor never fires creates no file
+    lazy = MonitorLog(str(tmp_path / "never.csv"))
+    lazy.close()
+    assert not (tmp_path / "never.csv").exists()
+
+
+def test_sample_from_warns_on_meta_newer_than_synthesizer(
+        tmp_path, monkeypatch, capsys):
+    """meta/encoders are written at training START, the synthesizer at the
+    END: a later crashed run leaves the newest meta paired with an older
+    synthesizer.  _run_sample_from must say so instead of silently
+    decoding through mismatched artifacts."""
+    import pickle
+    import time
+    from types import SimpleNamespace
+
+    import fed_tgan_tpu.data.decode as decode_mod
+    import fed_tgan_tpu.data.schema as schema_mod
+    import fed_tgan_tpu.runtime.checkpoint as ckpt_mod
+    from fed_tgan_tpu import cli
+
+    models = tmp_path / "models"
+    synth = models / "synthesizer"
+    synth.mkdir(parents=True)
+    (synth / "params.msgpack").write_bytes(b"x")
+    (models / "label_encoders_toy.pickle").write_bytes(
+        pickle.dumps([{"label_encoder": None}]))
+    meta_p = models / "toy.json"
+    meta_p.write_text("{}")
+    # meta newer than every synthesizer file = the mismatch signature
+    now = time.time()
+    os.utime(synth / "params.msgpack", (now - 100, now - 100))
+    os.utime(meta_p, (now, now))
+
+    monkeypatch.setattr(
+        ckpt_mod, "load_synthesizer",
+        lambda d: SimpleNamespace(sample=lambda n, seed: None))
+    monkeypatch.setattr(
+        schema_mod.TableMeta, "load_json", staticmethod(lambda p: None))
+    monkeypatch.setattr(decode_mod, "decode_matrix",
+                        lambda m, meta, enc: pd.DataFrame({"a": [1, 2]}))
+    args = SimpleNamespace(
+        sample_from=str(tmp_path), sample_rows=2, seed=0,
+        out_dir=str(tmp_path / "out"), quiet=True)
+    assert cli._run_sample_from(args) == 0
+    assert "is newer than the saved" in capsys.readouterr().out
+
+    # synthesizer newer than meta (the healthy case): no warning
+    os.utime(synth / "params.msgpack", (now + 100, now + 100))
+    assert cli._run_sample_from(args) == 0
+    assert "is newer than the saved" not in capsys.readouterr().out
