@@ -41,6 +41,7 @@ from fed_tgan_tpu.parallel.multihost import (
 )
 from fed_tgan_tpu.train.federated import RoundBookkeeping, _pad_to, make_federated_epoch
 from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
+from fed_tgan_tpu.train.snapshots import AsyncWorker
 from fed_tgan_tpu.train.steps import (
     SampleProgramCache,
     TrainConfig,
@@ -68,6 +69,32 @@ def _snapshot_epochs(run: MultihostRun) -> set[int]:
     if run.sample_every:
         return {e for e in range(run.epochs) if e % run.sample_every == 0}
     return {run.epochs - 1}
+
+
+class _OrderedSender(AsyncWorker):
+    """Rank 1's pipelined message sender.
+
+    Every outbound message (chunk reports, snapshot payloads, the final
+    ``done``) goes through ONE worker in enqueue order, so the transport
+    never sees interleaved writes, while the expensive part of a snapshot
+    message — blocking on the device→host copy, pickling 40k rows into the
+    TCP socket — overlaps the next chunk's training instead of serializing
+    into the round (the single-host SnapshotWriter behavior, which this
+    path previously lacked).  JAX dispatch stays on the training thread;
+    the worker only finishes already-started copies and does IO.
+    """
+
+    def __init__(self, transport, max_pending: int = 2):
+        super().__init__(max_pending=max_pending)
+        self.transport = transport
+
+    def send(self, msg: dict, parts_finish=None) -> None:
+        self.submit(self._send, msg, parts_finish)
+
+    def _send(self, msg: dict, parts_finish) -> None:
+        if parts_finish is not None:
+            msg["snapshot_parts"] = parts_finish()
+        self.transport.send_obj(msg)
 
 
 def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun) -> dict:
@@ -146,53 +173,76 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     sampler = SampleProgramCache(spec, cfg, decode_fn=decode_fn)
     firing = _snapshot_epochs(run)
 
+    import contextlib
+
     epoch_fns: dict[int, object] = {}
+    # rank 1's sends are pipelined: the snapshot D2H copy + TCP hop ride a
+    # worker thread and overlap the next chunk's training (the reference
+    # samples and writes INSIDE the round, distributed.py:820,589-590).
+    # The with-block flushes queued sends at the end and re-raises worker
+    # errors without masking an in-body exception.
+    sender = _OrderedSender(transport) if transport.rank == 1 else None
     e, end = 0, run.epochs
-    while e < end:
-        nxt = min((f for f in firing if f >= e), default=end - 1)
-        size = min(nxt - e + 1, run.max_rounds_per_call, end - e)
-        if size not in epoch_fns:
-            epoch_fns[size] = make_federated_epoch(
-                spec, cfg, max_steps, mesh, k=1, rounds=size
-            )
-        t0 = time.time()
-        models_g, metrics, chain, _finite = epoch_fns[size](
-            models_g, data_g, cond_g, rows_g, steps_g, weights_g, chain
-        )
-        jax.block_until_ready(models_g)
-        seconds = time.time() - t0
-        last = e + size - 1
-
-        if transport.rank == 1:
-            # rank 1 is the reporting participant: post-psum state is
-            # replicated, so its shard is the global model
-            msg = {"type": "chunk", "rounds": size, "seconds": seconds, "last": last}
-            if last in firing:
-                params_g = local_shard(models_g.params_g)
-                state_g = local_shard(models_g.state_g)
-                # ship the packed {f32 cont, int8/16 disc} parts — the TCP
-                # hop benefits from the small layout exactly like the D2H
-                # transfer does; rank 0 scatters back to column order
-                msg["snapshot_parts"] = sampler.sample(
-                    params_g,
-                    state_g,
-                    pooled_cond,
-                    run.sample_rows,
-                    jax.random.key(run.seed + last + 29),
+    with sender if sender is not None else contextlib.nullcontext():
+        while e < end:
+            nxt = min((f for f in firing if f >= e), default=end - 1)
+            size = min(nxt - e + 1, run.max_rounds_per_call, end - e)
+            if size not in epoch_fns:
+                epoch_fns[size] = make_federated_epoch(
+                    spec, cfg, max_steps, mesh, k=1, rounds=size
                 )
-            transport.send_obj(msg)
-        if run.log_every and (last % run.log_every == 0 or last == end - 1):
-            m = {k: float(np.asarray(v.addressable_shards[0].data).mean())
-                 for k, v in metrics.items()}
-            print(
-                f"[rank {transport.rank}] round {last}: "
-                f"loss_d={m['loss_d']:.3f} loss_g={m['loss_g']:.3f} "
-                f"({seconds / size:.3f}s/round)"
+            t0 = time.time()
+            models_g, metrics, chain, _finite = epoch_fns[size](
+                models_g, data_g, cond_g, rows_g, steps_g, weights_g, chain
             )
-        e += size
+            jax.block_until_ready(models_g)
+            seconds = time.time() - t0
+            last = e + size - 1
 
-    final_params = local_shard(models_g.params_g)
-    transport.send_obj({"type": "done", "params_g": final_params})
+            if sender is not None:
+                # rank 1 is the reporting participant: post-psum state is
+                # replicated, so its shard is the global model
+                msg = {"type": "chunk", "rounds": size, "seconds": seconds,
+                       "last": last}
+                finish = None
+                if last in firing:
+                    params_g = local_shard(models_g.params_g)
+                    state_g = local_shard(models_g.state_g)
+                    key = jax.random.key(run.seed + last + 29)
+                    # ship the packed {f32 cont, int8/16 disc} parts — the
+                    # TCP hop benefits from the small layout exactly like
+                    # the D2H transfer does; rank 0 scatters back to column
+                    # order.  Dispatch now (training thread), finish the
+                    # copy on the sender worker; oversized requests fall
+                    # back to the memory-bounded synchronous sample.
+                    sender.throttle()  # bound live result buffers FIRST
+                    if sampler.fits_async(run.sample_rows):
+                        finish = sampler.sample_async(
+                            params_g, state_g, pooled_cond,
+                            run.sample_rows, key,
+                        )
+                    else:
+                        parts = sampler.sample(
+                            params_g, state_g, pooled_cond,
+                            run.sample_rows, key,
+                        )
+                        finish = lambda parts=parts: parts  # noqa: E731
+                sender.send(msg, finish)
+            if run.log_every and (last % run.log_every == 0 or last == end - 1):
+                m = {k: float(np.asarray(v.addressable_shards[0].data).mean())
+                     for k, v in metrics.items()}
+                print(
+                    f"[rank {transport.rank}] round {last}: "
+                    f"loss_d={m['loss_d']:.3f} loss_g={m['loss_g']:.3f} "
+                    f"({seconds / size:.3f}s/round)"
+                )
+            e += size
+
+        final_params = local_shard(models_g.params_g)
+        if sender is not None:
+            sender.send({"type": "done", "params_g": final_params})
+    if sender is None:
+        transport.send_obj({"type": "done", "params_g": final_params})
     return {"params_g": final_params, "models": models_g}
 
 
@@ -233,21 +283,25 @@ def server_train(
             raw, os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv")
         )
 
-    while True:
-        msg = transport.recv_obj(1)
-        if msg["type"] == "done":
-            finals = [msg["params_g"]]
-            break
-        per_round = msg["seconds"] / msg["rounds"]
-        snap = msg.get("snapshot_parts")
-        for i in range(msg["rounds"]):
-            ei = msg["last"] - msg["rounds"] + 1 + i
-            hook = None
-            if snap is not None and ei == msg["last"]:
-                hook = lambda e, _b: write_snapshot(e, snap)
-            books._finish_round(per_round, ei, hook)
-        if run.log_every and not quiet and msg["last"] % run.log_every == 0:
-            print(f"[server] round {msg['last']}: {per_round:.3f}s/round")
+    # decode/CSV-write runs on a worker so the recv loop keeps draining the
+    # socket while pandas churns (the single-host SnapshotWriter behavior);
+    # the with-block settles in-flight writes and re-raises worker errors
+    with AsyncWorker(max_pending=2) as writer:
+        while True:
+            msg = transport.recv_obj(1)
+            if msg["type"] == "done":
+                finals = [msg["params_g"]]
+                break
+            per_round = msg["seconds"] / msg["rounds"]
+            snap = msg.get("snapshot_parts")
+            for i in range(msg["rounds"]):
+                ei = msg["last"] - msg["rounds"] + 1 + i
+                hook = None
+                if snap is not None and ei == msg["last"]:
+                    hook = lambda e, _b: writer.submit(write_snapshot, e, snap)
+                books._finish_round(per_round, ei, hook)
+            if run.log_every and not quiet and msg["last"] % run.log_every == 0:
+                print(f"[server] round {msg['last']}: {per_round:.3f}s/round")
 
     finals += [
         transport.recv_obj(rank)["params_g"]

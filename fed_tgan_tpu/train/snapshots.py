@@ -26,7 +26,70 @@ from fed_tgan_tpu.data.csvio import write_csv
 from fed_tgan_tpu.data.decode import decode_matrix
 
 
-class SnapshotWriter:
+class AsyncWorker:
+    """Single-worker task queue with bounded in-flight work.
+
+    The shared engine under every pipelined-IO path (snapshot CSVs, the
+    multihost sender/receiver): tasks run strictly in submit order on ONE
+    worker thread, ``submit`` blocks on the oldest task once ``max_pending``
+    are in flight (bounding live buffers AND surfacing worker errors near
+    the round that caused them), and ``drain``/``close`` settle everything,
+    re-raising the first failure.
+    """
+
+    def __init__(self, max_pending: int = 2):
+        self.max_pending = max_pending
+        self._pool = cf.ThreadPoolExecutor(max_workers=1)
+        self._pending: list[cf.Future] = []
+        self._last = None
+
+    def throttle(self) -> None:
+        """Block until fewer than ``max_pending`` tasks are in flight.
+        Callers that dispatch device work before submitting the host task
+        (SnapshotWriter) throttle FIRST so at most ``max_pending`` result
+        buffers are ever live."""
+        while len(self._pending) >= self.max_pending:
+            self._last = self._pending.pop(0).result()
+
+    def submit(self, fn, *args) -> None:
+        self.throttle()
+        self._pending.append(self._pool.submit(fn, *args))
+
+    def drain(self):
+        """Wait for ALL in-flight tasks (even past a failure); return the
+        last task's result (None if nothing ran).  Re-raises the first
+        worker error after every future has settled."""
+        err = None
+        while self._pending:
+            try:
+                self._last = self._pending.pop(0).result()
+            except Exception as e:
+                err = err or e
+        if err is not None:
+            raise err
+        return self._last
+
+    def close(self) -> None:
+        try:
+            self.drain()
+        finally:
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+            return
+        # unwinding from an in-body exception: clean up without masking it
+        try:
+            self.close()
+        except Exception as e:
+            print(f"WARNING: async worker failed during unwind: {e!r}")
+
+
+class SnapshotWriter(AsyncWorker):
     """``sample_hook``-compatible callable that writes snapshot CSVs off the
     training thread.
 
@@ -38,8 +101,7 @@ class SnapshotWriter:
     seed: per-epoch sample seed base (epoch is added, matching the
         synchronous ``trainer.sample(rows, seed=seed + epoch)`` path).
     max_pending: backpressure bound — at most this many snapshots in
-        flight; the hook blocks on the oldest when exceeded, which also
-        surfaces worker errors near the round that caused them.
+        flight; the hook blocks on the oldest when exceeded.
 
     Use as a context manager or call ``drain()`` when training ends;
     ``drain`` returns the last snapshot's decoded frame (handy for a final
@@ -48,28 +110,23 @@ class SnapshotWriter:
 
     def __init__(self, meta, encoders, path_fn: Callable[[int], str],
                  rows: int = 40000, seed: int = 0, max_pending: int = 2):
+        super().__init__(max_pending=max_pending)
         self.meta = meta
         self.encoders = encoders
         self.path_fn = path_fn
         self.rows = rows
         self.seed = seed
-        self.max_pending = max_pending
-        self._pool = cf.ThreadPoolExecutor(max_workers=1)
-        self._pending: list[cf.Future] = []
-        self._last = None
 
     def __call__(self, epoch: int, trainer) -> None:
-        # backpressure BEFORE dispatching, so at most max_pending snapshots'
-        # device buffers are ever live (also surfaces worker errors near the
-        # round that caused them)
-        while len(self._pending) >= self.max_pending:
-            self._last = self._pending.pop(0).result()
+        # throttle BEFORE dispatching, so at most max_pending snapshots'
+        # device buffers are ever live
+        self.throttle()
         if self._use_async(trainer):
             finish = trainer.sample_async(self.rows, seed=self.seed + epoch)
         else:  # no async path / huge request: sample now, write async
             decoded = trainer.sample(self.rows, seed=self.seed + epoch)
             finish = lambda: decoded  # noqa: E731
-        self._pending.append(self._pool.submit(self._finish, epoch, finish))
+        self.submit(self._finish, epoch, finish)
 
     def _use_async(self, trainer) -> bool:
         """Async dispatch keeps every generation chunk's result buffer live
@@ -87,39 +144,6 @@ class SnapshotWriter:
         raw = decode_matrix(finish(), self.meta, self.encoders)
         write_csv(raw, self.path_fn(epoch))
         return raw
-
-    def drain(self):
-        """Wait for ALL in-flight snapshots (even past a failure); return
-        the last decoded frame (None if the hook never fired).  Re-raises
-        the first worker error after every future has settled."""
-        err = None
-        while self._pending:
-            try:
-                self._last = self._pending.pop(0).result()
-            except Exception as e:
-                err = err or e
-        if err is not None:
-            raise err
-        return self._last
-
-    def close(self) -> None:
-        try:
-            self.drain()
-        finally:
-            self._pool.shutdown(wait=True)
-
-    def __enter__(self) -> "SnapshotWriter":
-        return self
-
-    def __exit__(self, exc_type, exc, tb) -> None:
-        if exc_type is None:
-            self.close()
-            return
-        # unwinding from an in-body exception: clean up without masking it
-        try:
-            self.close()
-        except Exception as e:
-            print(f"WARNING: snapshot writer failed during unwind: {e!r}")
 
 
 def result_path_fn(out_dir: str, name: str) -> Callable[[int], str]:

@@ -84,3 +84,151 @@ def test_snapshot_writer_error_propagates(trainer, tmp_path):
     writer(0, trainer)
     with pytest.raises(OSError):
         writer.drain()
+
+
+def test_async_worker_order_backpressure_and_errors():
+    import time
+
+    from fed_tgan_tpu.train.snapshots import AsyncWorker
+
+    done = []
+    with AsyncWorker(max_pending=2) as w:
+        for i in range(5):
+            w.submit(lambda i=i: done.append(i))
+    assert done == [0, 1, 2, 3, 4]  # strict submit order
+
+    # a failing task surfaces at drain/close, after later tasks settle
+    w2 = AsyncWorker(max_pending=2)
+    w2.submit(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    w2.submit(done.append, 99)
+    with pytest.raises(RuntimeError, match="boom"):
+        w2.drain()
+    assert 99 in done  # drain settled everything before re-raising
+    w2._pool.shutdown(wait=True)
+
+    # backpressure: the 3rd submit waits for the 1st task
+    slow = AsyncWorker(max_pending=2)
+    t0 = time.time()
+    slow.submit(time.sleep, 0.3)
+    slow.submit(time.sleep, 0.0)
+    assert time.time() - t0 < 0.15  # first two enqueue instantly
+    slow.submit(time.sleep, 0.0)
+    assert time.time() - t0 >= 0.25  # throttled on the oldest
+    slow.close()
+
+
+def test_ordered_sender_overlaps_and_orders_sends():
+    """Rank 1's sender must (a) return from send() without waiting on the
+    TCP hop or the deferred payload, (b) deliver messages in enqueue order,
+    (c) resolve deferred snapshot parts on the worker."""
+    import time
+
+    from fed_tgan_tpu.train.multihost import _OrderedSender
+
+    class SlowTransport:
+        rank = 1
+
+        def __init__(self):
+            self.sent = []
+
+        def send_obj(self, msg):
+            time.sleep(0.15)  # a slow network hop
+            self.sent.append(msg)
+
+    tr = SlowTransport()
+    t0 = time.time()
+    with _OrderedSender(tr, max_pending=2) as s:
+        s.send({"type": "chunk", "last": 0},
+               parts_finish=lambda: {"cont": "parts0"})
+        s.send({"type": "chunk", "last": 1})
+        dispatch_time = time.time() - t0
+    total = time.time() - t0
+    assert dispatch_time < 0.12  # sends enqueued without blocking on IO
+    assert total >= 0.28  # close() flushed both slow sends
+    assert [m["last"] for m in tr.sent] == [0, 1]
+    assert tr.sent[0]["snapshot_parts"] == {"cont": "parts0"}
+    assert "snapshot_parts" not in tr.sent[1]
+
+    # a transport failure surfaces on the training thread at close()
+    class BrokenTransport:
+        def send_obj(self, msg):
+            raise ConnectionResetError("peer gone")
+
+    s2 = _OrderedSender(BrokenTransport(), max_pending=2)
+    s2.send({"type": "chunk", "last": 0})
+    with pytest.raises(ConnectionResetError):
+        s2.close()
+
+
+def _packed_parts(trainer, rows, seed):
+    """Snapshot parts exactly as rank 1 ships them (exact packed layout)."""
+    import jax
+
+    from fed_tgan_tpu.ops.decode import make_device_decode_packed
+    from fed_tgan_tpu.train.steps import SampleProgramCache
+
+    decode_fn, _ = make_device_decode_packed(trainer.init.transformers[0].columns)
+    cache = SampleProgramCache(trainer.spec, CFG, decode_fn=decode_fn)
+    params_g, state_g = trainer._global_model()
+    return cache.sample(
+        params_g, state_g, trainer.server_cond, rows, jax.random.key(seed)
+    )
+
+
+def test_server_train_pipelines_snapshot_writes(trainer, tmp_path, monkeypatch):
+    """The server's recv loop must keep draining chunk messages while the
+    decode/CSV write churns on the worker: with per-snapshot write cost W
+    and per-chunk arrival gap T (the training time the real socket wait
+    covers), a pipelined server finishes ~len*T + W, a serial one
+    ~len*(T+W).  Asserted as the VERDICT criterion: a run WITH snapshots
+    stays within ~1.3x of the same message stream without them."""
+    import time
+
+    import fed_tgan_tpu.data.csvio as csvio
+    from fed_tgan_tpu.train.multihost import MultihostRun, server_train
+
+    init = trainer.init
+    parts = _packed_parts(trainer, rows=32, seed=3)
+    n_chunks, gap, write_cost = 5, 0.3, 0.3
+
+    class FakeTransport:
+        n_clients = 1
+
+        def __init__(self, with_snaps):
+            self.msgs = [
+                {"type": "chunk", "rounds": 1, "seconds": 0.01, "last": i,
+                 **({"snapshot_parts": parts} if with_snaps else {})}
+                for i in range(n_chunks)
+            ] + [{"type": "done", "params_g": {"w": np.ones(3)}}]
+
+        def recv_obj(self, rank):
+            time.sleep(gap)  # the socket wait while clients train the chunk
+            return self.msgs.pop(0)
+
+    real_write = csvio.write_csv
+
+    def slow_write(df, path):
+        time.sleep(write_cost)
+        real_write(df, path)
+
+    monkeypatch.setattr(csvio, "write_csv", slow_write)
+    run = MultihostRun(epochs=n_chunks, sample_every=1, sample_rows=32)
+    init_out = {"global_meta": init.global_meta, "encoders": init.encoders}
+
+    t0 = time.time()
+    server_train(FakeTransport(False), init_out, run, "toy",
+                 out_dir=str(tmp_path / "off"), quiet=True)
+    baseline = time.time() - t0
+
+    t0 = time.time()
+    books = server_train(FakeTransport(True), init_out, run, "toy",
+                         out_dir=str(tmp_path / "on"), quiet=True)
+    with_snaps = time.time() - t0
+
+    assert books.completed_epochs == n_chunks
+    for e in range(n_chunks):
+        assert (tmp_path / "on" / "toy_result"
+                / f"toy_synthesis_epoch_{e}.csv").exists()
+    # serial would be >= baseline + n_chunks*write_cost (~2x baseline);
+    # pipelined hides all but the tail write behind the next chunk's wait
+    assert with_snaps < 1.45 * baseline, (with_snaps, baseline)
