@@ -204,7 +204,7 @@ def _val_synth_f1(synth, val, reference_frame, target, categorical) -> float:
 
 def bench_utility(epochs: int = 500, n_clients: int = 2,
                   weighted: bool = True, bgm_backend: str = "sklearn",
-                  select: str = "utility", train_rows: int | None = None) -> dict:
+                  select: str = "none", train_rows: int | None = None) -> dict:
     """Driver-reproducible ΔF1: the reference utility_analysis protocol
     (reference Server/utility_analysis.py:94-119, README.md:67 headline
     0.0850 at 500 epochs on the FULL training CSV).
@@ -222,15 +222,18 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     data only — the 30% holdout stays untouched until the final scoring,
     so there is no leakage:
 
-    - ``"utility"`` (default): every ~48 rounds, fit LR/DT/RF on a
-      synthetic sample and score weighted-F1 on a fixed validation subset
-      of the training rows — the signal is the task metric itself (per-
-      round ΔF1 is noisy where plain similarity is near-monotone, so
-      similarity ranking just picks the last round).
+    - ``"utility"``: every ~48 rounds, fit LR/DT/RF on a synthetic sample
+      and score weighted-F1 on a fixed validation subset of the training
+      rows — the signal is the task metric itself.
     - ``"monitor"``: rank by the on-device Avg_JSD+Avg_WD monitor (two
-      scalars of host traffic per probe; cheapest, but ranks like
-      recency — kept for the ablation).
-    - ``"none"``: the reference's protocol (round ``epochs-1``).
+      scalars of host traffic per probe; cheapest, but similarity is
+      near-monotone in training so it ranks like recency).
+    - ``"swa"``: uniform average of back-half generator snapshots.
+    - ``"none"`` (default): the reference's protocol (round ``epochs-1``).
+      The measured ablation (PARITY.md) found per-round ΔF1 noise at this
+      data size exceeds any selectable between-round signal, so the
+      faithful protocol is also the best one; the modes stay for
+      ablations.
     """
     import pandas as pd
 
@@ -451,11 +454,14 @@ def main() -> int:
                     help="uniform FedAvg instead of similarity-weighted "
                          "(BASELINE.md config 2; full500/utility workloads)")
     ap.add_argument("--select", choices=["utility", "monitor", "swa", "none"],
-                    default="utility",
+                    default="none",
                     help="utility workload: snapshot selection over the "
                          "back half of training (train-side signal only; "
                          "'swa' = average late generator snapshots; "
-                         "'none' = the reference's blind round epochs-1)")
+                         "default 'none' = the reference's blind round "
+                         "epochs-1 — the measured ablation in PARITY.md "
+                         "found no selectable between-round signal at "
+                         "this data size)")
     ap.add_argument("--train-rows", type=int, default=None,
                     help="utility workload: GAN trains on this prefix of "
                          "the train split (classifier protocol unchanged) "
