@@ -144,6 +144,24 @@ def make_device_decode_packed16(columns: Sequence):
     f32 decode matters (e.g. multihost receivers that rebuild ``assemble``
     from TableMeta alone — the mu/sigma tables here live in the closure).
     """
+    return _make_device_decode_packed_q(columns, u_dtype=jnp.int16,
+                                        u_scale=U_SCALE)
+
+
+def make_device_decode_packed8(columns: Sequence):
+    """int8 variant of ``make_device_decode_packed16``: u ships as int8
+    (scale 127), halving the u block — 2 bytes/continuous value, ~25% off
+    the whole packed row for mixed tables like Intrusion.  Quantization
+    error is <= 4 sigma / 127 (~3% of a mode's std): visible in the 3rd
+    decimal of Avg_WD at most, so it stays OPT-IN
+    (``FED_TGAN_TPU_DECODE=packed8``) for transfer-starved links; the
+    default stays packed16.
+    """
+    return _make_device_decode_packed_q(columns, u_dtype=jnp.int8,
+                                        u_scale=127)
+
+
+def _make_device_decode_packed_q(columns: Sequence, u_dtype, u_scale: int):
     cont_pos, disc_pos = [], []
     means_pad, stds_pad = [], []
     plan = []  # (kind, start, n_active, codes) per column, in table order
@@ -185,7 +203,7 @@ def make_device_decode_packed16(columns: Sequence):
         for kind, start, size, codes in plan:
             if kind == "cont":
                 u = jnp.clip(encoded[:, start], -1.0, 1.0)
-                us.append(jnp.round(u * U_SCALE).astype(jnp.int16))
+                us.append(jnp.round(u * u_scale).astype(u_dtype))
                 ks.append(
                     jnp.argmax(encoded[:, start + 1 : start + 1 + size], axis=1)
                     .astype(jnp.int8)
@@ -195,13 +213,13 @@ def make_device_decode_packed16(columns: Sequence):
                 ds.append(jnp.asarray(codes)[sel].astype(int_dtype))
         n = encoded.shape[0]
         return {
-            "u": jnp.stack(us, axis=1) if us else jnp.zeros((n, 0), jnp.int16),
+            "u": jnp.stack(us, axis=1) if us else jnp.zeros((n, 0), u_dtype),
             "k": jnp.stack(ks, axis=1) if ks else jnp.zeros((n, 0), jnp.int8),
             "disc": jnp.stack(ds, axis=1) if ds else jnp.zeros((n, 0), int_dtype),
         }
 
     def assemble(parts: dict) -> np.ndarray:
-        u = np.asarray(parts["u"], dtype=np.float64) / U_SCALE
+        u = np.asarray(parts["u"], dtype=np.float64) / u_scale
         k = np.asarray(parts["k"], dtype=np.int64)
         disc = np.asarray(parts["disc"])
         n = u.shape[0] if len(cont_pos) else disc.shape[0]
@@ -252,16 +270,28 @@ def assemble_for_meta(meta):
 
 def select_snapshot_decode(columns: Sequence):
     """The trainers' snapshot decode: quantized packed16 by default,
-    bit-exact packed via ``FED_TGAN_TPU_EXACT_DECODE=1``.
+    overridable per run with ``FED_TGAN_TPU_DECODE=exact|packed16|packed8``
+    (or the ``FED_TGAN_TPU_EXACT_DECODE=1`` shorthand for ``exact``).
 
     packed16 quantizes every continuous output (error <= 4 sigma / 32767),
     so snapshot CSVs are not byte-identical to the exact f32 decode.  The
     error is far below metric precision, but golden values recorded against
     the exact path (or users needing bit-stable CSVs across versions) can
-    pin it with the env switch instead of editing trainer code.
+    pin ``exact``; ``packed8`` halves the u block for transfer-starved
+    links at ~3%-of-sigma quantization error (see
+    ``make_device_decode_packed8``).
     """
     import os
 
-    if os.environ.get("FED_TGAN_TPU_EXACT_DECODE", "") == "1":
+    mode = os.environ.get("FED_TGAN_TPU_DECODE", "")
+    if not mode and os.environ.get("FED_TGAN_TPU_EXACT_DECODE", "") == "1":
+        mode = "exact"
+    if mode == "exact":
         return make_device_decode_packed(columns)
-    return make_device_decode_packed16(columns)
+    if mode == "packed8":
+        return make_device_decode_packed8(columns)
+    if mode in ("", "packed16"):
+        return make_device_decode_packed16(columns)
+    raise ValueError(
+        f"FED_TGAN_TPU_DECODE={mode!r}: expected exact, packed16 or packed8"
+    )

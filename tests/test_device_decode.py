@@ -130,3 +130,36 @@ def test_select_snapshot_decode_env_switch(monkeypatch):
     assert set(parts) == {"cont", "disc"}
     full = np.asarray(jax.jit(make_device_decode(tf.columns))(enc))
     np.testing.assert_array_equal(assemble(parts), full.astype(np.float64))
+
+
+def test_packed8_decode_within_quantization_error():
+    from fed_tgan_tpu.ops.decode import make_device_decode_packed8
+
+    tf, enc = _fitted()
+    full = np.asarray(jax.jit(make_device_decode(tf.columns))(enc))
+    decode_fn, assemble = make_device_decode_packed8(tf.columns)
+    parts = jax.tree.map(np.asarray, jax.jit(decode_fn)(enc))
+    assert parts["u"].dtype == np.int8
+    out = assemble(parts)
+    # codes exact; continuous within 4*sigma/127 of the f32 decode
+    np.testing.assert_array_equal(out[:, 1], full[:, 1])
+    sigmas = np.concatenate([c.gmm.stds[c.gmm.active] for c in tf.columns
+                             if hasattr(c, "gmm")])
+    tol = SCALE * float(sigmas.max()) / 127 + 1e-9
+    assert np.abs(out[:, 0] - full[:, 0]).max() <= tol
+
+
+def test_select_snapshot_decode_packed8_and_bad_mode(monkeypatch):
+    from fed_tgan_tpu.ops.decode import select_snapshot_decode
+
+    tf, enc = _fitted()
+    monkeypatch.setenv("FED_TGAN_TPU_DECODE", "packed8")
+    decode_fn, _ = select_snapshot_decode(tf.columns)
+    parts = jax.tree.map(np.asarray, jax.jit(decode_fn)(enc))
+    assert parts["u"].dtype == np.int8
+
+    monkeypatch.setenv("FED_TGAN_TPU_DECODE", "packed99")
+    import pytest
+
+    with pytest.raises(ValueError, match="packed99"):
+        select_snapshot_decode(tf.columns)
