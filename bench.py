@@ -258,31 +258,41 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     cat_cols = init.global_meta.categorical_columns
 
     best_round = epochs - 1
+
+    def chunked_fit(step: int, on_probe) -> None:
+        """Train in ``step``-round fused chunks, calling ``on_probe(done)``
+        at each boundary in the back half of training."""
+        sel_start, done = epochs // 2, 0
+        while done < epochs:
+            nxt = min(done + step, epochs)
+            trainer.fit(nxt - done)
+            done = nxt
+            if done >= sel_start:
+                on_probe(done)
+
     if select == "monitor":
         from fed_tgan_tpu.train.monitor import SimilarityMonitor
 
         monitor = SimilarityMonitor(
             init.global_meta, init.encoders, real_train, seed=0
         )
-        # probe cadence = the fused-rounds program size, so selection adds
-        # zero extra compilations; scores use ONE fixed noise draw so
-        # rounds are compared on model quality, not sampling luck
-        step, sel_start = 16, epochs // 2
-        best_score, best_models = None, None
-        done = 0
-        while done < epochs:
-            nxt = min(done + step, epochs)
-            trainer.fit(nxt - done)
-            done = nxt
-            if done >= sel_start:
-                m = monitor.evaluate(trainer, seed=7)
-                score = m["avg_jsd"] + m["avg_wd"]
-                if best_score is None or score < best_score:
-                    best_score, best_models, best_round = (
-                        score, trainer.models, done - 1
-                    )
-        if best_models is not None:
-            trainer.models = best_models  # immutable pytrees: a cheap swap
+        best = {"score": None, "models": None}
+
+        def probe_monitor(done: int) -> None:
+            # ONE fixed noise draw so rounds are compared on model
+            # quality, not sampling luck
+            nonlocal best_round
+            m = monitor.evaluate(trainer, seed=7)
+            score = m["avg_jsd"] + m["avg_wd"]
+            if best["score"] is None or score < best["score"]:
+                best["score"], best["models"] = score, trainer.models
+                best_round = done - 1
+
+        # probe cadence = the fused-rounds program size, so selection
+        # adds zero extra compilations
+        chunked_fit(16, probe_monitor)
+        if best["models"] is not None:
+            trainer.models = best["models"]  # immutable pytrees: cheap swap
     elif select == "utility":
         # fixed validation subset of the TRAINING rows (selection bias is
         # shared across candidates; the holdout stays untouched)
@@ -290,26 +300,22 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
             n=min(1500, len(real_train) // 4), random_state=7
         )
         reference_frame = pd.concat([real_train, val])
-        step, sel_start = 48, epochs // 2
-        best_score, best_models = None, None
-        done = 0
-        while done < epochs:
-            nxt = min(done + step, epochs)
-            trainer.fit(nxt - done)
-            done = nxt
-            if done >= sel_start or done == epochs:
-                raw = decode_matrix(
-                    trainer.sample(len(real_train), seed=2 + done),
-                    init.global_meta, init.encoders,
-                )
-                score = _val_synth_f1(raw, val, reference_frame, "class",
-                                      cat_cols)
-                if best_score is None or score > best_score:
-                    best_score, best_models, best_round = (
-                        score, trainer.models, done - 1
-                    )
-        if best_models is not None:
-            trainer.models = best_models
+        best = {"score": None, "models": None}
+
+        def probe_utility(done: int) -> None:
+            nonlocal best_round
+            raw = decode_matrix(
+                trainer.sample(len(real_train), seed=2 + done),
+                init.global_meta, init.encoders,
+            )
+            score = _val_synth_f1(raw, val, reference_frame, "class", cat_cols)
+            if best["score"] is None or score > best["score"]:
+                best["score"], best["models"] = score, trainer.models
+                best_round = done - 1
+
+        chunked_fit(48, probe_utility)
+        if best["models"] is not None:
+            trainer.models = best["models"]
     elif select == "swa":
         # stochastic weight averaging of the GENERATOR over the back half:
         # late-round G snapshots orbit one basin (the psum-aggregated
@@ -318,25 +324,22 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         # lacks entirely.  BN running stats average linearly too.
         import jax
 
-        step, sel_start = 16, epochs // 2
-        acc, k = None, 0
-        done = 0
-        while done < epochs:
-            nxt = min(done + step, epochs)
-            trainer.fit(nxt - done)
-            done = nxt
-            if done >= sel_start:
-                g = (trainer.models.params_g, trainer.models.state_g)
-                acc = g if acc is None else jax.tree.map(
-                    lambda a, b: a + b, acc, g
-                )
-                k += 1
-        if acc is not None:
-            avg = jax.tree.map(lambda a: a / k, acc)
+        swa = {"acc": None, "k": 0}
+
+        def probe_swa(done: int) -> None:
+            g = (trainer.models.params_g, trainer.models.state_g)
+            swa["acc"] = g if swa["acc"] is None else jax.tree.map(
+                lambda a, b: a + b, swa["acc"], g
+            )
+            swa["k"] += 1
+
+        chunked_fit(16, probe_swa)
+        if swa["acc"] is not None:
+            avg = jax.tree.map(lambda a: a / swa["k"], swa["acc"])
             trainer.models = trainer.models._replace(
                 params_g=avg[0], state_g=avg[1]
             )
-            best_round = f"swa{k}x{step}"
+            best_round = f"swa{swa['k']}x16"
     else:
         trainer.fit(epochs)  # hook-free: rounds fuse into device programs
 
@@ -408,11 +411,22 @@ def bench_multihost(epochs: int = 10) -> dict:
             )
             for r in (0, 1, 2)
         ]
-        outs = [p.communicate(timeout=3600)[0] for p in procs]
+        outs = []
+        try:
+            # rank 0 first: an early server failure (e.g. port in use) is
+            # reported immediately instead of after the clients spend the
+            # rendezvous timeout retrying a dead server
+            for r, p in enumerate(procs):
+                outs.append(p.communicate(timeout=3600)[0])
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"multihost rank {r} failed:\n{outs[r][-3000:]}"
+                    )
+        finally:
+            for p in procs:  # never leak children on failure/timeout
+                if p.poll() is None:
+                    p.kill()
         launch_wall = time.time() - t0
-        for r, (p, o) in enumerate(zip(procs, outs)):
-            if p.returncode != 0:
-                raise RuntimeError(f"multihost rank {r} failed:\n{o[-3000:]}")
         m = re.search(r"multihost training wall ([0-9.]+)s", outs[0])
         if not m:
             raise RuntimeError(
@@ -445,8 +459,9 @@ def main() -> int:
     ap.add_argument("--workload",
                     choices=["round", "full500", "utility", "multihost"],
                     default="round")
-    ap.add_argument("--epochs", type=int, default=500,
-                    help="full500/utility workloads: number of rounds")
+    ap.add_argument("--epochs", type=int, default=None,
+                    help="number of rounds (default: 500 for "
+                         "full500/utility, 10 for multihost)")
     ap.add_argument("--clients", type=int, default=2,
                     help="full500/utility workloads: participants "
                          "(BASELINE.md configs 2/3 use 8)")
@@ -483,19 +498,22 @@ def main() -> int:
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".bench_jax_cache")
     )
+    epochs = args.epochs if args.epochs is not None else (
+        10 if args.workload == "multihost" else 500
+    )
     if args.workload == "round":
         out = bench_round(bgm_backend=args.bgm_backend)
     elif args.workload == "utility":
         out = bench_utility(
-            args.epochs, n_clients=args.clients, weighted=not args.uniform,
+            epochs, n_clients=args.clients, weighted=not args.uniform,
             bgm_backend=args.bgm_backend, select=args.select,
             train_rows=args.train_rows,
         )
     elif args.workload == "multihost":
-        out = bench_multihost(args.epochs if args.epochs != 500 else 10)
+        out = bench_multihost(epochs)
     else:
         out = bench_full500(
-            args.epochs, n_clients=args.clients, weighted=not args.uniform,
+            epochs, n_clients=args.clients, weighted=not args.uniform,
             bgm_backend=args.bgm_backend,
         )
     if args.bgm_backend != "sklearn":
