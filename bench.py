@@ -103,13 +103,20 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
     return df, init, trainer
 
 
-def bench_round(rounds: int = 8, bgm_backend: str = "sklearn") -> dict:
+def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
+                profile_dir: str | None = None) -> dict:
     """Seconds per round of the real server loop: every round runs the
     clients' local steps + weighted FedAvg and snapshots 40k rows to a CSV,
     exactly like the reference server (distributed.py:785-829).  The
     snapshot's transfer/decode/write overlap the next round's training
     (SnapshotWriter), as they do in the CLI path — the measured value is
-    total wall-clock of ``rounds`` rounds divided by ``rounds``."""
+    total wall-clock of ``rounds`` rounds divided by ``rounds``.
+
+    ``profile_dir`` wraps the measured rounds in a ``jax.profiler`` trace —
+    the tool for attributing the round's wall-clock between device compute
+    and the snapshot D2H transfer (warmup stays outside the trace).
+    """
+    import contextlib
     import tempfile
 
     from fed_tgan_tpu.train.snapshots import SnapshotWriter
@@ -120,15 +127,22 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn") -> dict:
             init.global_meta, init.encoders,
             lambda e: os.path.join(td, f"snapshot_{e}.csv"),
         )
+        if profile_dir is not None:
+            from fed_tgan_tpu.runtime.profiling import device_trace
+
+            trace = device_trace(profile_dir)
+        else:
+            trace = contextlib.nullcontext()
         with writer:
             # warmup: compiles the rounds=1 epoch program + sample/decode
             # programs and touches the whole transfer/decode/write path
             trainer.fit(2, sample_hook=writer)
             writer.drain()
-            t0 = time.time()
-            trainer.fit(rounds, sample_hook=writer)
-            writer.drain()
-            value = (time.time() - t0) / rounds
+            with trace:
+                t0 = time.time()
+                trainer.fit(rounds, sample_hook=writer)
+                writer.drain()
+                value = (time.time() - t0) / rounds
     return {
         "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)",
         "value": round(value, 4),
@@ -481,6 +495,9 @@ def main() -> int:
                     help="utility workload: GAN trains on this prefix of "
                          "the train split (classifier protocol unchanged) "
                          "— the PARITY.md data-size ablation")
+    ap.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
+                    help="round workload: capture a jax.profiler trace of "
+                         "the measured rounds into DIR")
     ap.add_argument("--bgm-backend", choices=["sklearn", "jax"],
                     default="sklearn",
                     help="init-time GMM fitting: sklearn (reference-exact "
@@ -502,7 +519,8 @@ def main() -> int:
         10 if args.workload == "multihost" else 500
     )
     if args.workload == "round":
-        out = bench_round(bgm_backend=args.bgm_backend)
+        out = bench_round(bgm_backend=args.bgm_backend,
+                          profile_dir=args.profile_dir)
     elif args.workload == "utility":
         out = bench_utility(
             epochs, n_clients=args.clients, weighted=not args.uniform,

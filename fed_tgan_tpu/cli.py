@@ -114,6 +114,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "<out-dir>/<name>_synthesis_sampled.csv")
     p.add_argument("--eval", action="store_true",
                    help="run similarity analysis against the training data at the end")
+    p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
+                   help="capture a jax.profiler (TensorBoard) trace of the "
+                        "LAST --profile-rounds training rounds into DIR — "
+                        "device timeline + XLA ops, the tool for answering "
+                        "'where does the round's wall-clock go'")
+    p.add_argument("--profile-rounds", type=int, default=3,
+                   help="rounds inside the --profile-dir trace (steady-state "
+                        "tail of the run; default 3)")
     p.add_argument("--quiet", action="store_true")
     # reference-compatible world bookkeeping (ignored in SPMD mode)
     p.add_argument("-rank", "--rank", type=int, default=None)
@@ -793,11 +801,26 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             e for e in range(start, start + remaining)
             if snapshot_due(e) or save_due(e) or mon_due(e)
         ]
+    # --profile-dir: trace the LAST profile_rounds rounds (steady state —
+    # warmup/compile stay outside the trace).  fit() filters hook_epochs to
+    # its own window, so splitting the run changes nothing else; fused
+    # stretches are bit-identical to sequential rounds either way.
+    prof_n = (min(max(args.profile_rounds, 1), remaining)
+              if args.profile_dir and remaining else 0)
+    log_every = 0 if args.quiet else max(1, remaining // 10)
     with mon_log:
         with snapshot:  # waits for in-flight snapshot CSVs, re-raises errors
-            trainer.fit(remaining,
-                        log_every=0 if args.quiet else max(1, remaining // 10),
-                        sample_hook=hook if use_hook else None, **fit_kwargs)
+            if remaining - prof_n:
+                trainer.fit(remaining - prof_n, log_every=log_every,
+                            sample_hook=hook if use_hook else None,
+                            **fit_kwargs)
+            if prof_n:
+                from fed_tgan_tpu.runtime.profiling import device_trace
+
+                with device_trace(args.profile_dir):
+                    trainer.fit(prof_n, log_every=log_every,
+                                sample_hook=hook if use_hook else None,
+                                **fit_kwargs)
             last_epoch = trainer.completed_epochs - 1
             if args.sample_every == 0 and last_epoch >= 0:
                 snapshot(last_epoch, trainer)

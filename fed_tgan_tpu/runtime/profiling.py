@@ -1,0 +1,34 @@
+"""Device-timeline tracing.
+
+The reference's only profiling is host wall-clock lists it writes to CSVs
+(reference Server/dtds/distributed.py:790-824); on a TPU the interesting
+question — how much of a round is MXU compute vs HBM traffic vs the D2H
+snapshot transfer — needs the XLA device timeline.  ``device_trace`` wraps
+``jax.profiler`` (TensorBoard profile plugin / Perfetto output) as a
+best-effort context manager: a backend that cannot trace (some tunneled
+transports) degrades to a warning, never a failed run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def device_trace(profile_dir: str):
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(profile_dir)
+        started = True
+    except Exception as exc:  # pragma: no cover - backend-dependent
+        print(f"WARNING: profiler trace unavailable ({exc}); "
+              "running untraced")
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+            print(f"profiler trace written to {profile_dir} "
+                  "(open with TensorBoard -> Profile, or Perfetto)")

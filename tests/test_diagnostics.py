@@ -41,3 +41,19 @@ def test_gradient_flow_structure_and_finiteness(tmp_path):
     out = tmp_path / "gradflow.png"
     plot_gradient_flow(stats, str(out))
     assert out.exists() and out.stat().st_size > 0
+
+
+def test_device_trace_writes_profile(tmp_path):
+    """device_trace captures an XLA timeline (plugins/profile/<ts>/...)
+    around whatever device work runs inside the context."""
+    import jax
+    import jax.numpy as jnp
+
+    from fed_tgan_tpu.runtime.profiling import device_trace
+
+    with device_trace(str(tmp_path)):
+        jax.block_until_ready(jax.jit(lambda x: x * 2)(jnp.ones((8, 8))))
+    profile_root = tmp_path / "plugins" / "profile"
+    assert profile_root.is_dir()
+    runs = list(profile_root.iterdir())
+    assert runs and any(runs[0].iterdir())  # a timestamped dir with files
