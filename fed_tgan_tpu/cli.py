@@ -226,6 +226,9 @@ def _run_multihost_init(args) -> int:
             sample_rows=args.sample_rows,
             seed=args.seed,
             log_every=0 if args.quiet else max(1, args.epochs // 10),
+            save_every=args.save_every,
+            ckpt_dir=args.ckpt_dir or os.path.join(args.out_dir, "checkpoint"),
+            resume=args.resume,
         )
 
     if args.rank == 0:

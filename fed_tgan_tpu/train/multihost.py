@@ -51,7 +51,16 @@ from fed_tgan_tpu.train.steps import (
 
 @dataclass(frozen=True)
 class MultihostRun:
-    """The per-run knobs shared by the server and client drivers."""
+    """The per-run knobs shared by the server and client drivers.
+
+    ``epochs`` is the TOTAL round budget — a resumed run does the
+    remainder, like the single-host CLI.  ``save_every``/``ckpt_dir``/
+    ``resume`` give the multi-process world the same crash story as the
+    single-host trainer (runtime/checkpoint.py): each participant rank
+    persists its own shard of the state and the on-device key chain, so a
+    relaunch with ``resume=True`` continues bit-exactly.  The reference
+    has nothing here — a crashed multi-process run restarts from epoch 0.
+    """
 
     epochs: int
     sample_every: int = 1
@@ -59,6 +68,9 @@ class MultihostRun:
     seed: int = 0
     max_rounds_per_call: int = 16
     log_every: int = 0
+    save_every: int = 0
+    ckpt_dir: str | None = None
+    resume: bool = False
 
 
 def _snapshot_epochs(run: MultihostRun) -> set[int]:
@@ -69,6 +81,55 @@ def _snapshot_epochs(run: MultihostRun) -> set[int]:
     if run.sample_every:
         return {e for e in range(run.epochs) if e % run.sample_every == 0}
     return {run.epochs - 1}
+
+
+def _ckpt_path(run: MultihostRun, rank: int) -> str:
+    import os
+
+    return os.path.join(run.ckpt_dir, f"multihost_rank{rank}.pkl")
+
+
+def _save_participant(run: MultihostRun, rank: int, models_g, chain,
+                      epochs_done: int) -> None:
+    """Persist this rank's view of the training state, atomically.
+
+    Post-psum model state is replicated, so each rank's shard IS the
+    global model; the key chain is replicated too.  Saving per-rank keeps
+    the protocol free of any shared-filesystem assumption — each host
+    writes only its own disk, exactly where it will resume.
+    """
+    import os
+    import pickle
+
+    kd = jax.random.key_data(chain)
+    state = {
+        "format": 1,
+        "rank": rank,
+        "seed": run.seed,
+        "epochs_done": epochs_done,
+        "models": local_shard(models_g),
+        "chain": np.asarray(kd.addressable_shards[0].data),
+    }
+    os.makedirs(run.ckpt_dir, exist_ok=True)
+    path = _ckpt_path(run, rank)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f)
+    os.replace(tmp, path)  # atomic: a crash mid-write never corrupts
+
+
+def _load_participant(run: MultihostRun, rank: int) -> dict:
+    import pickle
+
+    with open(_ckpt_path(run, rank), "rb") as f:
+        state = pickle.load(f)
+    if state.get("rank") != rank or state.get("seed") != run.seed:
+        raise RuntimeError(
+            f"checkpoint {_ckpt_path(run, rank)} was written by "
+            f"rank={state.get('rank')} seed={state.get('seed')}, not this "
+            f"run's rank={rank} seed={run.seed}"
+        )
+    return state
 
 
 class _OrderedSender(AsyncWorker):
@@ -161,8 +222,15 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     # committed sharding here — a multi-controller mesh is not fully
     # addressable from one process, so device_put would raise.  Cost: each
     # chunk size may compile twice (uncommitted then committed key).
-    one = init_models(init_key, spec, cfg)
-    models_g = from_local_chunk(mesh, add_axis(one))
+    e_start = 0
+    if run.resume and run.ckpt_dir:
+        saved = _load_participant(run, transport.rank)
+        e_start = int(saved["epochs_done"])
+        chain = jax.random.wrap_key_data(np.asarray(saved["chain"]))
+        models_g = from_local_chunk(mesh, add_axis(saved["models"]))
+    else:
+        one = init_models(init_key, spec, cfg)
+        models_g = from_local_chunk(mesh, add_axis(one))
 
     # generation uses the POOLED empirical frequencies from the init
     # protocol (the reference server's full-table Cond, distributed.py:565-580)
@@ -182,10 +250,23 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     # The with-block flushes queued sends at the end and re-raises worker
     # errors without masking an in-body exception.
     sender = _OrderedSender(transport) if transport.rank == 1 else None
-    e, end = 0, run.epochs
+    e, end = e_start, run.epochs
+
+    def save_due(last: int) -> bool:
+        return bool(run.save_every and run.ckpt_dir) and (
+            (last + 1) % run.save_every == 0 or last == end - 1
+        )
+
+    # chunk boundaries must land on every round with host-side work due —
+    # snapshots AND checkpoints — so fused stretches stay maximal otherwise
+    boundaries = set(firing)
+    if run.save_every and run.ckpt_dir:
+        boundaries |= {r for r in range(e_start, end)
+                       if (r + 1) % run.save_every == 0}
+
     with sender if sender is not None else contextlib.nullcontext():
         while e < end:
-            nxt = min((f for f in firing if f >= e), default=end - 1)
+            nxt = min((f for f in boundaries if f >= e), default=end - 1)
             size = min(nxt - e + 1, run.max_rounds_per_call, end - e)
             if size not in epoch_fns:
                 epoch_fns[size] = make_federated_epoch(
@@ -228,6 +309,9 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                         )
                         finish = lambda parts=parts: parts  # noqa: E731
                 sender.send(msg, finish)
+            if save_due(last):
+                _save_participant(run, transport.rank, models_g, chain,
+                                  epochs_done=last + 1)
             if run.log_every and (last % run.log_every == 0 or last == end - 1):
                 m = {k: float(np.asarray(v.addressable_shards[0].data).mean())
                      for k, v in metrics.items()}

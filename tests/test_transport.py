@@ -328,3 +328,98 @@ print(f"rank {{rank}} ok")
         got = pickle.load(f)
     for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_multihost_checkpoint_resume_bit_exact(tmp_path):
+    """Kill-and-relaunch story for the multi-process world: train 2 rounds
+    with save_every=2, relaunch every rank with resume=True for a 4-round
+    total budget, and the final params must be BIT-IDENTICAL to one
+    uninterrupted in-process fit(4) on the same shards/seed (the reference
+    restarts a crashed multi-process run from epoch 0)."""
+    import pickle
+    import subprocess
+    import sys
+
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    shards, paths = _toy_shards(tmp_path)
+    port = 25000 + os.getpid() % 2000
+
+    driver = tmp_path / "mh_resume_driver.py"
+    driver.write_text(f"""
+import pickle, sys
+rank, epochs, resume = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3] == "1"
+from fed_tgan_tpu.parallel.multihost import initialize_multihost
+initialize_multihost("127.0.0.1", {port}, 3, rank, backend="cpu", n_local_devices=1)
+from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
+from fed_tgan_tpu.train.multihost import MultihostRun, client_train, server_train
+run = MultihostRun(epochs=epochs, sample_every=0, sample_rows=32, seed=0,
+                   save_every=2, ckpt_dir=r"{tmp_path}/mh_ckpt", resume=resume)
+if rank == 0:
+    with ServerTransport({port}, 2, timeout_ms=120_000) as t:
+        from fed_tgan_tpu.federation.distributed import server_initialize
+        out = server_initialize(t, seed=0)
+        server_train(t, out, run, "toy", out_dir=r"{tmp_path}")
+else:
+    import pandas as pd
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.federation.distributed import client_initialize
+    pre = TablePreprocessor(
+        frame=pd.read_csv(sys.argv[4]), name="toy",
+        categorical_columns=["color", "flag"], target_column="flag",
+        problem_type="binary_classification",
+    )
+    with ClientTransport("127.0.0.1", {port}, rank, timeout_ms=120_000) as t:
+        out = client_initialize(t, pre, seed=0)
+        from fed_tgan_tpu.train.steps import TrainConfig
+        res = client_train(t, out, TrainConfig(batch_size=40, embedding_dim=16), run)
+    with open(r"{tmp_path}" + f"/params_resume_rank{{rank}}.pkl", "wb") as f:
+        pickle.dump(res["params_g"], f)
+print(f"rank {{rank}} ok")
+""")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+
+    def launch(epochs, resume):
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(driver), str(r), str(epochs), resume]
+                + ([paths[r - 1]] if r else []),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=env, cwd="/root/repo",
+            )
+            for r in (0, 1, 2)
+        ]
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+
+    launch(2, "0")  # rounds 0-1, checkpoint written at round 1
+    assert (tmp_path / "mh_ckpt" / "multihost_rank1.pkl").exists()
+    assert (tmp_path / "mh_ckpt" / "multihost_rank2.pkl").exists()
+    launch(4, "1")  # resume -> rounds 2-3
+
+    clients = [
+        TablePreprocessor(
+            frame=s, name="toy", categorical_columns=["color", "flag"],
+            target_column="flag", problem_type="binary_classification",
+        )
+        for s in shards
+    ]
+    init = federated_initialize(clients, seed=0)
+    trainer = FederatedTrainer(
+        init, config=TrainConfig(batch_size=40, embedding_dim=16), seed=0
+    )
+    trainer.fit(4)
+    import jax
+
+    want = jax.tree.map(lambda x: np.asarray(x)[0], trainer.models.params_g)
+    with open(tmp_path / "params_resume_rank1.pkl", "rb") as f:
+        got = pickle.load(f)
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
