@@ -90,7 +90,7 @@ def _ckpt_path(run: MultihostRun, rank: int) -> str:
 
 
 def _save_participant(run: MultihostRun, rank: int, models_g, chain,
-                      epochs_done: int) -> None:
+                      epochs_done: int, n_clients: int, cfg) -> None:
     """Persist this rank's view of the training state, atomically.
 
     Post-psum model state is replicated, so each rank's shard IS the
@@ -106,6 +106,8 @@ def _save_participant(run: MultihostRun, rank: int, models_g, chain,
         "format": 1,
         "rank": rank,
         "seed": run.seed,
+        "n_clients": n_clients,
+        "config": repr(cfg),
         "epochs_done": epochs_done,
         "models": local_shard(models_g),
         "chain": np.asarray(kd.addressable_shards[0].data),
@@ -118,16 +120,24 @@ def _save_participant(run: MultihostRun, rank: int, models_g, chain,
     os.replace(tmp, path)  # atomic: a crash mid-write never corrupts
 
 
-def _load_participant(run: MultihostRun, rank: int) -> dict:
+def _load_participant(run: MultihostRun, rank: int, n_clients: int,
+                      cfg) -> dict:
+    """Load + validate this rank's checkpoint.  Resuming under a changed
+    topology or training config would silently produce a trajectory that
+    is neither bit-exact nor comparable, so mismatches fail fast."""
     import pickle
 
     with open(_ckpt_path(run, rank), "rb") as f:
         state = pickle.load(f)
-    if state.get("rank") != rank or state.get("seed") != run.seed:
+    want = {"rank": rank, "seed": run.seed, "n_clients": n_clients,
+            "config": repr(cfg)}
+    got = {k: state.get(k) for k in want}
+    if got != want:
+        diffs = {k: (got[k], want[k]) for k in want if got[k] != want[k]}
         raise RuntimeError(
-            f"checkpoint {_ckpt_path(run, rank)} was written by "
-            f"rank={state.get('rank')} seed={state.get('seed')}, not this "
-            f"run's rank={rank} seed={run.seed}"
+            f"checkpoint {_ckpt_path(run, rank)} does not match this run "
+            f"(saved vs current): {diffs}; resume needs the same world "
+            "size, seed and training config"
         )
     return state
 
@@ -222,13 +232,38 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     # committed sharding here — a multi-controller mesh is not fully
     # addressable from one process, so device_put would raise.  Cost: each
     # chunk size may compile twice (uncommitted then committed key).
-    e_start = 0
+    e_start, saved = 0, None
     if run.resume and run.ckpt_dir:
-        saved = _load_participant(run, transport.rank)
-        e_start = int(saved["epochs_done"])
+        try:
+            saved = _load_participant(run, transport.rank, n_clients, cfg)
+            e_start = int(saved["epochs_done"])
+        except FileNotFoundError:
+            saved = None  # this rank never saved: candidate fresh start
+        # every participant must resume from the SAME round: a kill landing
+        # between two ranks' saves (or before one rank's first save) leaves
+        # different epochs_done, and training from mismatched rounds would
+        # desync the cross-host collectives — wedging the psum until the
+        # transport timeout at best.  Agree via a mesh-wide min/max BEFORE
+        # any training chunk, and abort with the remedy on mismatch.
+        import jax.numpy as jnp
+
+        vals = from_local_chunk(mesh, np.asarray([e_start], np.int32))
+        lo = int(jax.device_get(jnp.min(vals)))
+        hi = int(jax.device_get(jnp.max(vals)))
+        if lo != hi:
+            raise RuntimeError(
+                f"ranks disagree on the resume round (min {lo}, max {hi}) — "
+                "the previous run died between two ranks' checkpoint "
+                f"writes, so a consistent round-{lo} state no longer exists "
+                "on every host; relaunch without --resume to restart from "
+                "round 0 (each rank keeps only its latest checkpoint in "
+                f"{run.ckpt_dir})"
+            )
+    if saved is not None:
         chain = jax.random.wrap_key_data(np.asarray(saved["chain"]))
         models_g = from_local_chunk(mesh, add_axis(saved["models"]))
     else:
+        e_start = 0
         one = init_models(init_key, spec, cfg)
         models_g = from_local_chunk(mesh, add_axis(one))
 
@@ -311,7 +346,8 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                 sender.send(msg, finish)
             if save_due(last):
                 _save_participant(run, transport.rank, models_g, chain,
-                                  epochs_done=last + 1)
+                                  epochs_done=last + 1,
+                                  n_clients=n_clients, cfg=cfg)
             if run.log_every and (last % run.log_every == 0 or last == end - 1):
                 m = {k: float(np.asarray(v.addressable_shards[0].data).mean())
                      for k, v in metrics.items()}

@@ -385,7 +385,7 @@ print(f"rank {{rank}} ok")
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
 
-    def launch(epochs, resume):
+    def launch(epochs, resume, expect_ok=True):
         procs = [
             subprocess.Popen(
                 [sys.executable, str(driver), str(r), str(epochs), resume]
@@ -396,8 +396,10 @@ print(f"rank {{rank}} ok")
             for r in (0, 1, 2)
         ]
         outs = [p.communicate(timeout=600)[0] for p in procs]
-        for r, (p, out) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        if expect_ok:
+            for r, (p, out) in enumerate(zip(procs, outs)):
+                assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        return procs, outs
 
     launch(2, "0")  # rounds 0-1, checkpoint written at round 1
     assert (tmp_path / "mh_ckpt" / "multihost_rank1.pkl").exists()
@@ -423,3 +425,12 @@ print(f"rank {{rank}} ok")
         got = pickle.load(f)
     for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # desync scenario: the previous run "died" between the two ranks'
+    # checkpoint writes (simulated by deleting rank 2's file).  The resume
+    # must abort fast with the remedy, not wedge the collectives.
+    (tmp_path / "mh_ckpt" / "multihost_rank2.pkl").unlink()
+    procs, outs = launch(6, "1", expect_ok=False)
+    combined = "\n".join(outs)
+    assert any(p.returncode != 0 for p in procs), combined[-2000:]
+    assert "disagree on the resume round" in combined, combined[-3000:]
