@@ -326,15 +326,9 @@ def _parse_date_formats(items) -> dict:
 
 
 def _cpu_pinned() -> bool:
-    """Whether this process can only ever see the cpu platform.  The config
-    value only reflects ``config.update``; an env-var pin is read by jax at
-    backend-init time, so consult both."""
-    import jax
+    from fed_tgan_tpu.parallel.mesh import cpu_pinned
 
-    platforms = getattr(jax.config, "jax_platforms", None) or os.environ.get(
-        "JAX_PLATFORMS"
-    )
-    return bool(platforms) and set(str(platforms).split(",")) <= {"cpu"}
+    return cpu_pinned()
 
 
 def _select_backend(args) -> int:

@@ -21,6 +21,21 @@ from jax.sharding import Mesh
 CLIENTS_AXIS = "clients"
 
 
+def cpu_pinned() -> bool:
+    """Whether this process can only ever see the cpu platform.  The config
+    value only reflects ``config.update``; an env-var pin is read by jax at
+    backend-init time, so consult both.  NOTE: on hosts whose site hook
+    pre-imports jax against an accelerator plugin, a fresh subprocess may
+    ignore an env-var cpu pin — in-process ``jax.config.update`` is the
+    reliable route (provision_virtual_cpu does this)."""
+    import os
+
+    platforms = getattr(jax.config, "jax_platforms", None) or os.environ.get(
+        "JAX_PLATFORMS"
+    )
+    return bool(platforms) and set(str(platforms).split(",")) <= {"cpu"}
+
+
 def backend_initialized() -> bool:
     """True once any JAX backend client exists in this process."""
     try:

@@ -137,6 +137,7 @@ def test_watchdog_aborts_on_hung_backend_touch(monkeypatch, tmp_path):
 def test_watchdog_noop_on_fast_touch_and_initialized_backend(monkeypatch):
     import fed_tgan_tpu.parallel.mesh as mesh
 
+    monkeypatch.setattr(mesh, "backend_initialized", lambda: False)
     aborts = []
     # fast touch: watchdog disarms, no abort even after the timeout window
     # (timeout generous enough that a descheduled single-core host can't
@@ -164,6 +165,9 @@ def test_watchdog_crashing_touch_returns_probe_style_failure(
 
     import fed_tgan_tpu.parallel.mesh as mesh
 
+    # an earlier test may have initialized the in-process backend, which
+    # would legitimately skip the touch — this test pins the crash path
+    monkeypatch.setattr(mesh, "backend_initialized", lambda: False)
     monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
     stamp = pathlib.Path(mesh._probe_stamp_path())
     stamp.touch()

@@ -57,3 +57,26 @@ def test_device_trace_writes_profile(tmp_path):
     assert profile_root.is_dir()
     runs = list(profile_root.iterdir())
     assert runs and any(runs[0].iterdir())  # a timestamped dir with files
+
+
+def test_doctor_cli_all_green_on_cpu(tmp_path):
+    """The triage command: every layer passes on the CPU test platform and
+    the exit code reflects it.  TMPDIR is redirected so the probe stamp
+    cannot leak into (or vouch for) other runs."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, TMPDIR=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, "-m", "fed_tgan_tpu.doctor", "--backend", "cpu",
+         "--probe-timeout", "90"],
+        capture_output=True, text=True, timeout=400, env=env,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    assert "5/5 checks passed" in proc.stdout
+    assert "FAIL" not in proc.stdout
+    for name in ("runtime", "backend", "virtual-mesh", "transport",
+                 "compile-cache"):
+        assert f"OK   {name}" in proc.stdout, proc.stdout
