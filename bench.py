@@ -72,7 +72,8 @@ def _ensure_responsive_backend() -> str:
 
     jax.config.update("jax_platforms", "cpu")
     print(f"WARNING: accelerator backend unusable ({reason}); "
-          "benchmarking on CPU", file=sys.stderr)
+          "benchmarking on CPU.  Diagnose the stack with "
+          "`python -m fed_tgan_tpu.doctor`", file=sys.stderr)
     return "(cpu-fallback)"
 
 

@@ -114,6 +114,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "<out-dir>/<name>_synthesis_sampled.csv")
     p.add_argument("--eval", action="store_true",
                    help="run similarity analysis against the training data at the end")
+    p.add_argument("--decode", choices=["exact", "packed16", "packed8"],
+                   default=None,
+                   help="snapshot transfer layout (default packed16): "
+                        "exact = bit-stable vs the f32 on-device decode; "
+                        "packed8 = halve the continuous block on "
+                        "transfer-starved links (error <= 4 sigma/127). "
+                        "Equivalent to FED_TGAN_TPU_DECODE")
     p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler (TensorBoard) trace of the "
                         "LAST --profile-rounds training rounds into DIR — "
@@ -418,6 +425,12 @@ def _enable_compile_cache() -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.decode:
+        # the trainers read the selection at construction time via
+        # ops.decode.select_snapshot_decode; a flag beats an env var for
+        # discoverability, the env var stays for programmatic use
+        os.environ["FED_TGAN_TPU_DECODE"] = args.decode
 
     if args.sample_from:
         rc = _select_backend(args)
