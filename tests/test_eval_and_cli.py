@@ -478,3 +478,44 @@ def test_sample_from_warns_on_meta_newer_than_synthesizer(
     os.utime(synth / "params.msgpack", (now + 100, now + 100))
     assert cli._run_sample_from(args) == 0
     assert "is newer than the saved" not in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_cli_all_training_features_interact(tmp_path, toy_frame):
+    """Snapshots + on-device monitor + checkpoints + profiler trace in ONE
+    run: the fit split for --profile-dir must not break hook scheduling,
+    incremental monitor rows, resume checkpoints, or the final eval."""
+    data_p = tmp_path / "toy.csv"
+    toy_frame.to_csv(data_p, index=False)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "fed_tgan_tpu.cli",
+            "--datapath", str(data_p), "--dataset", "custom",
+            "--categorical", "color", "flag",
+            "--target-column", "flag",
+            "--n-clients", "2", "--batch-size", "50",
+            "--embedding-dim", "16", "--sample-rows", "80",
+            "--backend", "cpu", "--n-virtual-devices", "2",
+            "--out-dir", str(tmp_path), "--epochs", "4",
+            "--sample-every", "2", "--monitor-every", "2",
+            "--save-every", "2", "--decode", "exact",
+            "--profile-dir", str(tmp_path / "trace"),
+            "--profile-rounds", "1", "--eval",
+        ],
+        capture_output=True, text=True, env=env, cwd="/root/repo", timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    out = proc.stdout
+    assert "final Avg_JSD=" in out
+    assert "profiler trace written" in out
+    # snapshots at rounds 0 and 2
+    for e in (0, 2):
+        assert (tmp_path / "toy_result" / f"toy_synthesis_epoch_{e}.csv").exists()
+    # monitor rows flushed incrementally (header + rounds 0 and 2)
+    mon = (tmp_path / "monitor_similarity.csv").read_text().splitlines()
+    assert mon[0].startswith("Epoch_No.") and len(mon) == 3
+    # resume checkpoint exists; the profiler produced a timeline
+    assert (tmp_path / "checkpoint" / "host.pkl").exists()
+    assert (tmp_path / "trace" / "plugins" / "profile").is_dir()
