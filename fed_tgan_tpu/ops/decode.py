@@ -218,21 +218,45 @@ def _make_device_decode_packed_q(columns: Sequence, u_dtype, u_scale: int):
             "disc": jnp.stack(ds, axis=1) if ds else jnp.zeros((n, 0), int_dtype),
         }
 
+    tables = {
+        "mu": mu, "sg": sg, "cont_idx": cont_idx, "disc_idx": disc_idx,
+        "n_cols": n_cols, "u_scale": u_scale,
+    }
+    # plain-array tables attached so a REMOTE receiver of the packed parts
+    # (multihost rank 0) can rebuild the assemble from one pickled message
+    # instead of needing the transformer closure
+    decode.tables = tables
+    return decode, make_assemble_packed_q(tables)
+
+
+def make_assemble_packed_q(tables: dict):
+    """Host-side assemble for quantized packed parts, built from the plain
+    numpy TABLES a quantized decode carries (``decode.tables``) rather than
+    a transformer closure — picklable, so the multihost server can decode
+    snapshots shipped in the transfer-minimal layout after receiving the
+    tables once."""
+    mu = np.asarray(tables["mu"], dtype=np.float64)
+    sg = np.asarray(tables["sg"], dtype=np.float64)
+    cont_idx = np.asarray(tables["cont_idx"], dtype=np.int32)
+    disc_idx = np.asarray(tables["disc_idx"], dtype=np.int32)
+    n_cols = int(tables["n_cols"])
+    u_scale = int(tables["u_scale"])
+
     def assemble(parts: dict) -> np.ndarray:
         u = np.asarray(parts["u"], dtype=np.float64) / u_scale
         k = np.asarray(parts["k"], dtype=np.int64)
         disc = np.asarray(parts["disc"])
-        n = u.shape[0] if len(cont_pos) else disc.shape[0]
+        n = u.shape[0] if len(cont_idx) else disc.shape[0]
         out = np.empty((n, n_cols), dtype=np.float64)
-        if len(cont_pos):
+        if len(cont_idx):
             sig = np.take_along_axis(sg[None, :, :], k[:, :, None], axis=2)[..., 0]
             m = np.take_along_axis(mu[None, :, :], k[:, :, None], axis=2)[..., 0]
             out[:, cont_idx] = u * SCALE * sig + m
-        if len(disc_pos):
+        if len(disc_idx):
             out[:, disc_idx] = disc
         return out
 
-    return decode, assemble
+    return assemble
 
 
 def _make_assemble(cont_idx: np.ndarray, disc_idx: np.ndarray, n_cols: int):

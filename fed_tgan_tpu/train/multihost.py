@@ -270,9 +270,15 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     # generation uses the POOLED empirical frequencies from the init
     # protocol (the reference server's full-table Cond, distributed.py:565-580)
     pooled_cond = CondSampler.from_counts(init_out["cond_counts"], spec)
-    from fed_tgan_tpu.ops.decode import make_device_decode_packed
+    # snapshots ship in the same transfer-minimal layout as the single-host
+    # path (default packed16, FED_TGAN_TPU_DECODE selects): rank 1 sends the
+    # mu/sigma denorm tables ONCE with the first snapshot, after which every
+    # 40k-row payload is ~25-40% smaller on the wire than the exact f32
+    # layout; ``exact`` keeps the meta-only decode (bit-stable CSVs).
+    from fed_tgan_tpu.ops.decode import select_snapshot_decode
 
-    decode_fn, _assemble = make_device_decode_packed(init_out["transformer"].columns)
+    decode_fn, _assemble = select_snapshot_decode(init_out["transformer"].columns)
+    decode_tables = getattr(decode_fn, "tables", None)  # None on exact
     sampler = SampleProgramCache(spec, cfg, decode_fn=decode_fn)
     firing = _snapshot_epochs(run)
 
@@ -321,16 +327,21 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                 msg = {"type": "chunk", "rounds": size, "seconds": seconds,
                        "last": last}
                 finish = None
+                if last in firing and decode_tables is not None:
+                    # denorm tables ride the FIRST snapshot message only
+                    msg["decode_tables"] = decode_tables
+                    decode_tables = None
                 if last in firing:
                     params_g = local_shard(models_g.params_g)
                     state_g = local_shard(models_g.state_g)
                     key = jax.random.key(run.seed + last + 29)
-                    # ship the packed {f32 cont, int8/16 disc} parts — the
-                    # TCP hop benefits from the small layout exactly like
-                    # the D2H transfer does; rank 0 scatters back to column
-                    # order.  Dispatch now (training thread), finish the
-                    # copy on the sender worker; oversized requests fall
-                    # back to the memory-bounded synchronous sample.
+                    # ship the quantized packed parts — the TCP hop
+                    # benefits from the small layout exactly like the D2H
+                    # transfer does; rank 0 denormalizes with the tables
+                    # from the first snapshot message.  Dispatch now
+                    # (training thread), finish the copy on the sender
+                    # worker; oversized requests fall back to the
+                    # memory-bounded synchronous sample.
                     sender.throttle()  # bound live result buffers FIRST
                     if sampler.fits_async(run.sample_rows):
                         finish = sampler.sample_async(
@@ -384,20 +395,23 @@ def server_train(
     import os
 
     from fed_tgan_tpu.data.decode import decode_matrix
-    from fed_tgan_tpu.ops.decode import assemble_for_meta
+    from fed_tgan_tpu.ops.decode import assemble_for_meta, make_assemble_packed_q
 
     result_dir = os.path.join(out_dir, f"{name}_result")
     os.makedirs(result_dir, exist_ok=True)
+    # meta-only assemble covers the exact f32 layout; if rank 1 ships
+    # quantized packed parts, its first snapshot message carries the denorm
+    # tables and the assemble is swapped before that snapshot is written
     assemble = assemble_for_meta(init_out["global_meta"])
 
     books = RoundBookkeeping()
     books._init_bookkeeping()
 
-    def write_snapshot(epoch: int, parts: dict) -> None:
+    def write_snapshot(epoch: int, parts: dict, asm) -> None:
         from fed_tgan_tpu.data.csvio import write_csv
 
         raw = decode_matrix(
-            assemble(parts), init_out["global_meta"], init_out["encoders"]
+            asm(parts), init_out["global_meta"], init_out["encoders"]
         )
         write_csv(
             raw, os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv")
@@ -412,13 +426,18 @@ def server_train(
             if msg["type"] == "done":
                 finals = [msg["params_g"]]
                 break
+            if "decode_tables" in msg:
+                assemble = make_assemble_packed_q(msg["decode_tables"])
             per_round = msg["seconds"] / msg["rounds"]
             snap = msg.get("snapshot_parts")
             for i in range(msg["rounds"]):
                 ei = msg["last"] - msg["rounds"] + 1 + i
                 hook = None
                 if snap is not None and ei == msg["last"]:
-                    hook = lambda e, _b: writer.submit(write_snapshot, e, snap)
+                    # bind the assemble NOW: the worker may run this after
+                    # a later message has been received
+                    hook = (lambda e, _b, asm=assemble:
+                            writer.submit(write_snapshot, e, snap, asm))
                 books._finish_round(per_round, ei, hook)
             if run.log_every and not quiet and msg["last"] % run.log_every == 0:
                 print(f"[server] round {msg['last']}: {per_round:.3f}s/round")

@@ -232,3 +232,79 @@ def test_server_train_pipelines_snapshot_writes(trainer, tmp_path, monkeypatch):
     # serial would be >= baseline + n_chunks*write_cost (~2x baseline);
     # pipelined hides all but the tail write behind the next chunk's wait
     assert with_snaps < 1.45 * baseline, (with_snaps, baseline)
+
+
+def test_assemble_tables_pickle_roundtrip(trainer):
+    """The denorm tables a quantized decode carries must survive pickling
+    (they ride one transport message to the multihost server) and rebuild
+    an assemble identical to the local one."""
+    import pickle
+
+    import jax
+
+    from fed_tgan_tpu.ops.decode import (
+        make_assemble_packed_q,
+        make_device_decode_packed16,
+    )
+
+    decode_fn, local_asm = make_device_decode_packed16(
+        trainer.init.transformers[0].columns
+    )
+    from fed_tgan_tpu.train.steps import SampleProgramCache
+
+    cache = SampleProgramCache(trainer.spec, CFG, decode_fn=decode_fn)
+    params_g, state_g = trainer._global_model()
+    parts = cache.sample(params_g, state_g, trainer.server_cond, 40,
+                         jax.random.key(5))
+    remote_asm = make_assemble_packed_q(
+        pickle.loads(pickle.dumps(decode_fn.tables))
+    )
+    np.testing.assert_array_equal(remote_asm(parts), local_asm(parts))
+
+
+def test_server_train_decodes_packed_parts_via_shipped_tables(
+        trainer, tmp_path):
+    """Rank 0 receives QUANTIZED packed snapshots: the first message's
+    decode_tables swap in the quantized assemble, and the written CSV
+    decodes to valid raw values."""
+    import jax
+    import pandas as pd
+
+    from fed_tgan_tpu.ops.decode import make_device_decode_packed16
+    from fed_tgan_tpu.train.multihost import MultihostRun, server_train
+    from fed_tgan_tpu.train.steps import SampleProgramCache
+
+    init = trainer.init
+    decode_fn, _ = make_device_decode_packed16(init.transformers[0].columns)
+    cache = SampleProgramCache(trainer.spec, CFG, decode_fn=decode_fn)
+    params_g, state_g = trainer._global_model()
+    parts = cache.sample(params_g, state_g, trainer.server_cond, 32,
+                         jax.random.key(9))
+
+    class FakeTransport:
+        n_clients = 1
+
+        def __init__(self):
+            self.msgs = [
+                {"type": "chunk", "rounds": 1, "seconds": 0.01, "last": 0,
+                 "snapshot_parts": parts, "decode_tables": decode_fn.tables},
+                {"type": "chunk", "rounds": 1, "seconds": 0.01, "last": 1,
+                 "snapshot_parts": parts},
+                {"type": "done", "params_g": {"w": np.ones(2)}},
+            ]
+
+        def recv_obj(self, rank):
+            return self.msgs.pop(0)
+
+    run = MultihostRun(epochs=2, sample_every=1, sample_rows=32)
+    books = server_train(
+        FakeTransport(),
+        {"global_meta": init.global_meta, "encoders": init.encoders},
+        run, "toy", out_dir=str(tmp_path), quiet=True,
+    )
+    assert books.completed_epochs == 2
+    for e in (0, 1):
+        snap = pd.read_csv(tmp_path / "toy_result"
+                           / f"toy_synthesis_epoch_{e}.csv")
+        assert len(snap) == 32
+        assert set(snap["color"].astype(str)) <= {"red", "green", "blue"}
