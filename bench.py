@@ -77,6 +77,87 @@ def _ensure_responsive_backend() -> str:
     return "(cpu-fallback)"
 
 
+_DEADLINE_CHILDREN: list = []  # Popen handles to kill if the deadline fires
+
+
+def _deadline_minutes(epochs: int, workload: str = "round") -> float:
+    """Default mid-run deadline: generous for ANY legitimate run.
+
+    Scaled by the round count so a long `--epochs` run is never killed as a
+    false wedge: 0.15 min/round is ~3.5x the slowest legitimate per-round
+    time (the ~2.6 s/round CPU fallback), with a 120-min floor that covers
+    init + eval.  ``FED_TGAN_BENCH_DEADLINE_MIN`` overrides outright
+    (<= 0 disables).
+
+    multihost is capped BELOW bench_multihost's per-rank
+    ``communicate(timeout=3600)`` so the deadline — the path that kills the
+    rank processes and emits the parseable line — fires before a raw
+    ``TimeoutExpired`` traceback does.  A legitimate multihost run must
+    finish inside that same 3600 s budget anyway, so the cap costs nothing.
+    """
+    default = max(120.0, 0.15 * epochs)
+    if workload == "multihost":
+        default = min(default, 55.0)
+    try:
+        return float(os.environ.get("FED_TGAN_BENCH_DEADLINE_MIN", default))
+    except ValueError:
+        print("bench: ignoring non-numeric FED_TGAN_BENCH_DEADLINE_MIN",
+              file=sys.stderr)
+        return default
+
+
+def _arm_run_deadline(workload: str, tag: str, epochs: int = 500,
+                      _emit=None, _exit=None):
+    """Guard the MEASUREMENT itself against a wedge, not just backend init.
+
+    ``touch_backend_with_watchdog`` closes the probe-cache hole at startup,
+    but the tunneled backend can also wedge mid-run — then the first device
+    sync inside ``trainer.fit`` blocks forever inside an uninterruptible C
+    call and the bench records NOTHING (strictly worse than a tagged CPU
+    fallback: the whole round's perf evidence is lost, which is exactly what
+    happened to BENCH_r02).  This arms a watchdog that, if the workload
+    hasn't finished within the deadline (`_deadline_minutes`), kills any
+    registered child processes (`_DEADLINE_CHILDREN` — the multihost ranks,
+    which would otherwise be orphaned holding the rendezvous port), prints a
+    self-explaining JSON line (so a driver capturing stdout still records a
+    parseable result) and force-exits — ``os._exit`` because the stuck main
+    thread can't receive a Python exception.
+
+    Returns a ``cancel()`` callable for the success path.  ``_emit``/
+    ``_exit`` are test seams.
+    """
+    from fed_tgan_tpu.parallel.mesh import arm_watchdog
+
+    deadline_min = _deadline_minutes(epochs, workload)
+    if deadline_min <= 0:  # explicit opt-out
+        return lambda: None
+    t0 = time.time()
+
+    def _fire() -> None:
+        for p in list(_DEADLINE_CHILDREN):
+            try:
+                p.kill()
+            except Exception:
+                pass
+        line = json.dumps({
+            "metric": f"bench_{workload}(wedged-mid-run){tag}",
+            "value": round(time.time() - t0, 1),
+            "unit": f"s elapsed without finishing (deadline "
+                    f"{deadline_min:.1f} min) — backend likely wedged "
+                    "mid-measurement; no perf claim",
+            "vs_baseline": 0,
+        })
+        (_emit or (lambda s: print(s, flush=True)))(line)
+        print(f"bench: {workload} exceeded the {deadline_min:.1f} min "
+              "deadline; aborting so the wedge is recorded instead of "
+              "hanging.  Diagnose with `python -m fed_tgan_tpu.doctor`",
+              file=sys.stderr, flush=True)
+        (_exit or os._exit)(0)
+
+    return arm_watchdog(deadline_min * 60.0, _fire,
+                        name="bench-run-deadline")
+
+
 def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
            bgm_backend: str = "sklearn", df=None):
     import pandas as pd
@@ -426,6 +507,7 @@ def bench_multihost(epochs: int = 10) -> dict:
             )
             for r in (0, 1, 2)
         ]
+        _DEADLINE_CHILDREN.extend(procs)  # the run deadline kills, not orphans
         outs = []
         try:
             # rank 0 first: an early server failure (e.g. port in use) is
@@ -441,6 +523,8 @@ def bench_multihost(epochs: int = 10) -> dict:
             for p in procs:  # never leak children on failure/timeout
                 if p.poll() is None:
                     p.kill()
+                if p in _DEADLINE_CHILDREN:
+                    _DEADLINE_CHILDREN.remove(p)
         launch_wall = time.time() - t0
         m = re.search(r"multihost training wall ([0-9.]+)s", outs[0])
         if not m:
@@ -519,6 +603,7 @@ def main() -> int:
     epochs = args.epochs if args.epochs is not None else (
         10 if args.workload == "multihost" else 500
     )
+    cancel_deadline = _arm_run_deadline(args.workload, tag, epochs)
     if args.workload == "round":
         out = bench_round(bgm_backend=args.bgm_backend,
                           profile_dir=args.profile_dir)
@@ -535,6 +620,7 @@ def main() -> int:
             epochs, n_clients=args.clients, weighted=not args.uniform,
             bgm_backend=args.bgm_backend,
         )
+    cancel_deadline()
     if args.bgm_backend != "sklearn":
         out["metric"] += f"({args.bgm_backend}-bgm)"
     out["metric"] += tag

@@ -153,6 +153,23 @@ def _probe_stamp_path() -> str:
     return os.path.join(tempfile.gettempdir(), f".fed_tgan_backend_ok_{key}")
 
 
+def arm_watchdog(timeout_s: float, on_fire, name: str = "watchdog"):
+    """Daemon thread that calls ``on_fire()`` unless cancelled within
+    ``timeout_s``; returns the cancel callable.  Shared core of the
+    backend-touch watchdog and the bench run deadline, so the
+    Event/daemon-thread/force-exit shape cannot drift between them."""
+    import threading
+
+    done = threading.Event()
+
+    def _watch() -> None:
+        if not done.wait(timeout_s):
+            on_fire()
+
+    threading.Thread(target=_watch, daemon=True, name=name).start()
+    return done.set
+
+
 def touch_backend_with_watchdog(
     timeout_s: float = 180.0,
     who: str = "",
@@ -182,9 +199,6 @@ def touch_backend_with_watchdog(
         return True, ""
     import os
     import sys
-    import threading
-
-    done = threading.Event()
 
     def _drop_stamp() -> None:
         # invalidate the (now-stale) positive stamp so the NEXT run
@@ -195,30 +209,26 @@ def touch_backend_with_watchdog(
         except OSError:
             pass
 
-    def _watch() -> None:
-        if not done.wait(timeout_s):
-            _drop_stamp()
-            print(
-                f"{who}accelerator backend unusable (jax.devices() did not "
-                f"return within {timeout_s:.0f}s after a positive probe — "
-                "the tunnel likely wedged inside the probe-cache window); "
-                "aborting — retry later or use --backend cpu",
-                file=sys.stderr,
-                flush=True,
-            )
-            (_abort or os._exit)(3)
+    def _fire() -> None:
+        _drop_stamp()
+        print(
+            f"{who}accelerator backend unusable (jax.devices() did not "
+            f"return within {timeout_s:.0f}s after a positive probe — "
+            "the tunnel likely wedged inside the probe-cache window); "
+            "aborting — retry later or use --backend cpu",
+            file=sys.stderr,
+            flush=True,
+        )
+        (_abort or os._exit)(3)
 
-    watchdog = threading.Thread(target=_watch, daemon=True,
-                                name="backend-touch-watchdog")
-    watchdog.start()
+    cancel = arm_watchdog(timeout_s, _fire, name="backend-touch-watchdog")
     try:
         (jax.devices if _touch is None else _touch)()
     except Exception as exc:
-        done.set()
         _drop_stamp()
         return False, f"backend init crashed after a positive probe: {exc}"
     finally:
-        done.set()
+        cancel()
     return True, ""
 
 

@@ -270,3 +270,94 @@ def test_probe_stamp_is_uid_scoped_and_nofollow(monkeypatch, tmp_path):
     monkeypatch.setattr(subprocess, "run", counting_run)
     ok, reason = mesh.probe_backend_responsive(timeout_s=1)
     assert ok and calls["n"] == 1 and reason != "cached"
+
+
+def test_bench_run_deadline_fires_with_tagged_line(monkeypatch):
+    """A workload that outlives the deadline must emit a parseable,
+    clearly-tagged JSON line and exit 0 — a driver capturing stdout then
+    records a self-explaining result instead of nothing (the BENCH_r02
+    failure mode, where a mid-run wedge would hang the bench forever)."""
+    import importlib
+    import json
+    import time
+
+    bench = importlib.import_module("bench")
+    monkeypatch.setenv("FED_TGAN_BENCH_DEADLINE_MIN", str(0.2 / 60.0))
+    emitted, exits = [], []
+    bench._arm_run_deadline("round", "(cpu-fallback)",
+                            _emit=emitted.append, _exit=exits.append)
+    deadline = time.time() + 10
+    while not exits and time.time() < deadline:
+        time.sleep(0.05)
+    assert exits == [0]
+    rec = json.loads(emitted[0])
+    assert "wedged-mid-run" in rec["metric"]
+    assert "(cpu-fallback)" in rec["metric"]
+    assert rec["vs_baseline"] == 0
+
+
+def test_bench_run_deadline_cancel_suppresses_firing(monkeypatch):
+    """The success path cancels the deadline: nothing is emitted even after
+    the deadline passes."""
+    import importlib
+    import time
+
+    bench = importlib.import_module("bench")
+    monkeypatch.setenv("FED_TGAN_BENCH_DEADLINE_MIN", str(0.2 / 60.0))
+    emitted, exits = [], []
+    cancel = bench._arm_run_deadline("round", "",
+                                     _emit=emitted.append,
+                                     _exit=exits.append)
+    cancel()
+    time.sleep(0.5)
+    assert emitted == [] and exits == []
+
+
+def test_bench_deadline_scales_with_epochs_and_env_overrides(monkeypatch):
+    """A legitimate long --epochs run must not be killed as a false wedge:
+    the default deadline scales with the round count; the env var overrides
+    outright."""
+    import importlib
+
+    bench = importlib.import_module("bench")
+    monkeypatch.delenv("FED_TGAN_BENCH_DEADLINE_MIN", raising=False)
+    assert bench._deadline_minutes(500) == 120.0          # floor
+    assert bench._deadline_minutes(2000) == 300.0         # 0.15 min/round
+    # multihost: capped below the per-rank communicate(timeout=3600) so the
+    # deadline (which kills the ranks and emits the tagged line) always
+    # fires before a raw TimeoutExpired traceback can
+    assert bench._deadline_minutes(10, "multihost") == 55.0
+    assert bench._deadline_minutes(2000, "multihost") == 55.0
+    monkeypatch.setenv("FED_TGAN_BENCH_DEADLINE_MIN", "7")
+    assert bench._deadline_minutes(2000) == 7.0
+    monkeypatch.setenv("FED_TGAN_BENCH_DEADLINE_MIN", "nope")
+    assert bench._deadline_minutes(2000) == 300.0         # bad value ignored
+
+
+def test_bench_deadline_kills_registered_children(monkeypatch):
+    """The deadline's os._exit would skip bench_multihost's finally-block
+    cleanup; registered rank processes must be killed by the firing path
+    itself so they are never orphaned holding the rendezvous port."""
+    import importlib
+    import subprocess
+    import sys
+    import time
+
+    bench = importlib.import_module("bench")
+    monkeypatch.setenv("FED_TGAN_BENCH_DEADLINE_MIN", str(0.2 / 60.0))
+    child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    bench._DEADLINE_CHILDREN.append(child)
+    try:
+        emitted, exits = [], []
+        bench._arm_run_deadline("multihost", "", _emit=emitted.append,
+                                _exit=exits.append)
+        deadline = time.time() + 10
+        while not exits and time.time() < deadline:
+            time.sleep(0.05)
+        assert exits == [0]
+        child.wait(timeout=10)  # killed by the firing path, not leaked
+        assert child.returncode not in (None, 0)
+    finally:
+        bench._DEADLINE_CHILDREN.remove(child)
+        if child.poll() is None:
+            child.kill()
