@@ -799,6 +799,16 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
 
             save_federated(tr, ckpt_dir, run_name=name)
 
+    def _hook_predispatch(e, tr):
+        # forward the trainer's pre-sync predispatch (train -> sample with
+        # no host round trip between) to the snapshot writer; sampling is
+        # dispatch-only, so the checkpoint/monitor parts of the composed
+        # hook above are unaffected
+        if snapshot_due(e):
+            snapshot.predispatch(e, tr)
+
+    hook.predispatch = _hook_predispatch
+
     # --epochs is the TOTAL round budget; a resumed run does the remainder
     remaining = max(0, args.epochs - trainer.completed_epochs)
     use_hook = bool(args.sample_every or args.save_every or monitor is not None)

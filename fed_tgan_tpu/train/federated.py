@@ -223,17 +223,22 @@ class RoundBookkeeping:
         }
         self.completed_epochs = 0
 
-    def _finish_round(self, t_round: float, e: int, sample_hook) -> None:
+    def _finish_round(self, t_round: float, e: int, sample_hook,
+                      pre_hook_s: float = 0.0) -> None:
+        """``pre_hook_s``: wall-clock a pre-sync snapshot predispatch spent
+        on this round (device dispatch + any writer backpressure) — booked
+        to the distribution phase so the train_aggregate column measures
+        only the chunk."""
         self.phase_times["train_aggregate"].append(t_round)
-        self.phase_times["distribution"].append(0.0)
-        self.epoch_times.append(t_round)
+        self.phase_times["distribution"].append(pre_hook_s)
+        self.epoch_times.append(t_round + pre_hook_s)
         self.completed_epochs += 1
         if sample_hook is not None:
             t1 = time.time()
             sample_hook(e, self)
             t_hook = time.time() - t1
-            self.phase_times["distribution"][-1] = t_hook
-            self.epoch_times[-1] = t_round + t_hook
+            self.phase_times["distribution"][-1] = pre_hook_s + t_hook
+            self.epoch_times[-1] = t_round + pre_hook_s + t_hook
 
     def _check_finite(self, metrics, first_epoch: int, mode: str) -> None:
         """Divergence detection (the reference has none, SURVEY §5.3): flags
@@ -418,6 +423,7 @@ class FederatedTrainer(RoundBookkeeping):
         while e < end:
             nxt = min((f for f in firing if f >= e), default=end - 1)
             size = min(nxt - e + 1, max_rounds_per_call, end - e)
+            prev = (self.models, self._key)  # last-good, for a failed sync
             t0 = time.time()
             models, metrics, self._key, finite = self._epoch_fn_for(size)(
                 models, data, cond, rows, steps, weights, self._key
@@ -426,28 +432,54 @@ class FederatedTrainer(RoundBookkeeping):
             # serves as the chunk's sync point); the full metric arrays are
             # pulled only on the failure path to name the bad round.  State
             # (models AND the already-advanced key chain) is committed BEFORE
-            # any raise so a checkpoint taken by an error handler stays
-            # consistent.  Starting the scalar's copy at dispatch time means
-            # bool(finite) below finds the value already en route instead of
-            # paying a fresh host<->device round trip after the chunk
-            # completes (~70 ms on a tunneled chip).
+            # the divergence raise so a checkpoint taken by an error handler
+            # stays consistent.  Starting the scalar's copy at dispatch time
+            # means bool(finite) below finds the value already en route
+            # instead of paying a fresh host<->device round trip after the
+            # chunk completes (~70 ms on a tunneled chip).
             try:
                 finite.copy_to_host_async()
             except AttributeError:
                 pass  # non-jax scalar (e.g. a test double)
+            # commit state NOW (the arrays are valid while still in flight)
+            # so the snapshot predispatch below can read the chunk's output
+            # arrays; a DEVICE failure rolls back to last-good below
+            self.models = models
+            last = e + size - 1
+            t_pre = 0.0
+            if (last in firing and on_nonfinite != "raise"
+                    and hasattr(sample_hook, "predispatch")):
+                # queue the snapshot's generation program behind the chunk
+                # BEFORE the host sync: the device goes train -> sample
+                # back-to-back instead of idling a host round trip.  Skipped
+                # under on_nonfinite="raise" (don't sample a model the check
+                # below may reject); the hook's normal call then dispatches.
+                # Its wall cost (usually microseconds of dispatch, but the
+                # writer's backpressure can block here) is measured and
+                # booked to the distribution phase, not the chunk.
+                _t = time.time()
+                sample_hook.predispatch(last, self)
+                t_pre = time.time() - _t
             ok = on_nonfinite == "ignore" or bool(finite)
             # epoch_times feeds timestamp_experiment.csv — must measure the
             # chunk's real wall-clock, not async dispatch latency
-            jax.block_until_ready(models)
-            self.models = models
+            try:
+                jax.block_until_ready(models)
+            except Exception:
+                # device/runtime failure mid-chunk: the chunk's arrays are
+                # error-poisoned — roll BOTH models and key chain back to
+                # the last-good pair so an error handler's checkpoint saves
+                # a consistent, materializable state
+                self.models, self._key = prev
+                raise
             if not ok:
                 self._check_finite(metrics, e, on_nonfinite)
-            per_round = (time.time() - t0) / size
-            last = e + size - 1
+            per_round = (time.time() - t0 - t_pre) / size
             for ei in range(e, e + size):
                 self._finish_round(
                     per_round, ei,
                     sample_hook if (ei == last and ei in firing) else None,
+                    pre_hook_s=t_pre if ei == last else 0.0,
                 )
             if log_every and any(ei % log_every == 0 for ei in range(e, e + size)):
                 m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)

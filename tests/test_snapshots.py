@@ -308,3 +308,67 @@ def test_server_train_decodes_packed_parts_via_shipped_tables(
                            / f"toy_synthesis_epoch_{e}.csv")
         assert len(snap) == 32
         assert set(snap["color"].astype(str)) <= {"red", "green", "blue"}
+
+
+def test_predispatch_path_matches_regular(trainer, tmp_path):
+    """fit() predispatches each firing round's generation program before its
+    host sync (device runs train -> sample back-to-back).  The trajectory
+    and every snapshot CSV must be bit-identical to a hook without the
+    predispatch contract (sampling is a pure function of the committed
+    params; predispatch only reorders host-side dispatch)."""
+    import jax
+
+    from fed_tgan_tpu.train.snapshots import SnapshotWriter
+
+    init = trainer.init
+
+    def run(use_predispatch, sub):
+        (tmp_path / sub).mkdir()
+        tr = FederatedTrainer(init, config=CFG, mesh=client_mesh(2), seed=0)
+        w = SnapshotWriter(init.global_meta, init.encoders,
+                           lambda e, s=sub: str(tmp_path / s / f"snap_{e}.csv"),
+                           rows=64, seed=5)
+        # a bare lambda hides .predispatch, forcing the regular path
+        hook = w if use_predispatch else (lambda e, t: w(e, t))
+        with w:
+            tr.fit(3, sample_hook=hook)
+        return tr
+
+    a, b = run(True, "pre"), run(False, "plain")
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        a.models.params_g, b.models.params_g,
+    )
+    for e in range(3):
+        assert ((tmp_path / "pre" / f"snap_{e}.csv").read_bytes()
+                == (tmp_path / "plain" / f"snap_{e}.csv").read_bytes())
+
+
+def test_predispatch_stash_consumed_once(trainer, tmp_path):
+    """predispatch stashes one finisher; the same-epoch __call__ consumes it
+    without re-dispatching, another epoch drops it and dispatches fresh."""
+    calls = {"async": 0}
+
+    class Spy:
+        def fits_async(self, n):
+            return True
+
+        def sample_async(self, n, seed=0):
+            calls["async"] += 1
+            return lambda: trainer.sample(n, seed=seed)
+
+    w = SnapshotWriter(trainer.init.global_meta, trainer.init.encoders,
+                       lambda e: str(tmp_path / f"spy_{e}.csv"), rows=32)
+    spy = Spy()
+    with w:
+        w.predispatch(2, spy)
+        assert calls["async"] == 1
+        w(2, spy)                      # consumes the stash
+        assert calls["async"] == 1
+        w(3, spy)                      # regular dispatch
+        assert calls["async"] == 2
+        w.predispatch(4, spy)          # stale: never matched by __call__
+        w(5, spy)                      # drops the stash, dispatches fresh
+        assert calls["async"] == 4
+    assert os.path.exists(tmp_path / "spy_2.csv")
+    assert os.path.exists(tmp_path / "spy_5.csv")
