@@ -808,6 +808,7 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             snapshot.predispatch(e, tr)
 
     hook.predispatch = _hook_predispatch
+    hook.discard_predispatch = snapshot.discard_predispatch
 
     # --epochs is the TOTAL round budget; a resumed run does the remainder
     remaining = max(0, args.epochs - trainer.completed_epochs)

@@ -460,18 +460,25 @@ class FederatedTrainer(RoundBookkeeping):
                 _t = time.time()
                 sample_hook.predispatch(last, self)
                 t_pre = time.time() - _t
-            ok = on_nonfinite == "ignore" or bool(finite)
             # epoch_times feeds timestamp_experiment.csv — must measure the
-            # chunk's real wall-clock, not async dispatch latency
+            # chunk's real wall-clock, not async dispatch latency.  The sync
+            # must come BEFORE bool(finite): a runtime failure poisons every
+            # chunk output including the scalar, and only this sync has the
+            # rollback handler
             try:
                 jax.block_until_ready(models)
             except Exception:
                 # device/runtime failure mid-chunk: the chunk's arrays are
                 # error-poisoned — roll BOTH models and key chain back to
                 # the last-good pair so an error handler's checkpoint saves
-                # a consistent, materializable state
+                # a consistent, materializable state; a predispatched
+                # snapshot of the poisoned arrays must never be consumed
                 self.models, self._key = prev
+                discard = getattr(sample_hook, "discard_predispatch", None)
+                if discard is not None:
+                    discard()
                 raise
+            ok = on_nonfinite == "ignore" or bool(finite)
             if not ok:
                 self._check_finite(metrics, e, on_nonfinite)
             per_round = (time.time() - t0 - t_pre) / size

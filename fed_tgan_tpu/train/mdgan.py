@@ -296,6 +296,7 @@ class MDGANTrainer(RoundBookkeeping):
 
         for _ in range(epochs):
             t0 = time.time()
+            prev = (self.gen, self.disc, self._key)  # last-good on failed sync
             self._key, ekey = jax.random.split(self._key)
             gen, disc, metrics, finite = self._epoch_fn(
                 gen, disc, data, cond, rows, steps, ekey
@@ -304,9 +305,27 @@ class MDGANTrainer(RoundBookkeeping):
                 finite.copy_to_host_async()
             except AttributeError:
                 pass
-            jax.block_until_ready(gen)
+            # commit the in-flight arrays so the snapshot predispatch can
+            # read them; device goes train -> sample back-to-back with no
+            # host round trip between (same contract as FederatedTrainer)
             self.gen, self.disc = gen, disc
             e = self.completed_epochs
+            t_pre = 0.0
+            if (sample_hook is not None and on_nonfinite != "raise"
+                    and hasattr(sample_hook, "predispatch")):
+                _t = time.time()
+                sample_hook.predispatch(e, self)
+                t_pre = time.time() - _t
+            try:
+                jax.block_until_ready(gen)
+            except Exception:
+                # chunk arrays are error-poisoned: roll back to last-good;
+                # a predispatched snapshot of them must never be consumed
+                self.gen, self.disc, self._key = prev
+                discard = getattr(sample_hook, "discard_predispatch", None)
+                if discard is not None:
+                    discard()
+                raise
             # single-scalar divergence check; full metric arrays cross to
             # host only on the failure path (to name the bad round)
             if on_nonfinite != "ignore" and not bool(finite):
@@ -314,7 +333,8 @@ class MDGANTrainer(RoundBookkeeping):
                     jax.tree.map(lambda x: np.asarray(x)[None], metrics),
                     e, on_nonfinite,
                 )
-            self._finish_round(time.time() - t0, e, sample_hook)
+            self._finish_round(time.time() - t0 - t_pre, e, sample_hook,
+                               pre_hook_s=t_pre)
             if log_every and e % log_every == 0:
                 m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
                 print(

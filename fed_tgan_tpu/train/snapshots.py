@@ -119,6 +119,17 @@ class SnapshotWriter(AsyncWorker):
 
     _pre: tuple | None = None
 
+    def discard_predispatch(self) -> None:
+        """Drop an unconsumed stash.  Called by the trainers' failed-sync
+        rollback (the stashed finisher closes over error-poisoned arrays)
+        and by ``drain``/``close`` (an abandoned stash would otherwise pin
+        a full snapshot's device buffers for the writer's lifetime)."""
+        self._pre = None
+
+    def drain(self):
+        self.discard_predispatch()
+        return super().drain()
+
     def predispatch(self, epoch: int, trainer) -> None:
         """Dispatch this epoch's generation program NOW, ahead of the
         regular ``__call__``.  The trainer invokes this right after
@@ -130,6 +141,7 @@ class SnapshotWriter(AsyncWorker):
         stashed finisher; any other epoch (or a trainer without the async
         path) falls back to the regular dispatch, so correctness never
         depends on predispatch having happened."""
+        self._pre = None  # a stale stash must never survive a new dispatch
         self.throttle()  # same bound: at most max_pending snapshots live
         if self._use_async(trainer):
             self._pre = (epoch,

@@ -213,3 +213,30 @@ def test_mdgan_timing_and_save_time_stamp(fed_init, tmp_path):
     for f in ("time_train_d.csv", "time_loss_g.csv"):
         rows = (tmp_path / f).read_text().strip().splitlines()
         assert len(rows) == 2
+
+
+def test_mdgan_predispatch_matches_regular(fed_init, tmp_path):
+    """The MD-GAN engine honors SnapshotWriter.predispatch with the same
+    bit-identity contract as FederatedTrainer: trajectory and snapshot CSVs
+    are unchanged by the pre-sync dispatch."""
+    from fed_tgan_tpu.train.snapshots import SnapshotWriter
+
+    def run(use_predispatch, sub):
+        (tmp_path / sub).mkdir()
+        tr = MDGANTrainer(fed_init, config=CFG, mesh=client_mesh(4), seed=0)
+        w = SnapshotWriter(fed_init.global_meta, fed_init.encoders,
+                           lambda e, s=sub: str(tmp_path / s / f"snap_{e}.csv"),
+                           rows=64, seed=5)
+        hook = w if use_predispatch else (lambda e, t: w(e, t))
+        with w:
+            tr.fit(2, sample_hook=hook)
+        return tr
+
+    a, b = run(True, "pre"), run(False, "plain")
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        a.gen.params, b.gen.params,
+    )
+    for e in range(2):
+        assert ((tmp_path / "pre" / f"snap_{e}.csv").read_bytes()
+                == (tmp_path / "plain" / f"snap_{e}.csv").read_bytes())

@@ -372,3 +372,32 @@ def test_predispatch_stash_consumed_once(trainer, tmp_path):
         assert calls["async"] == 4
     assert os.path.exists(tmp_path / "spy_2.csv")
     assert os.path.exists(tmp_path / "spy_5.csv")
+
+
+def test_predispatch_discard_and_drain_drop_stash(trainer, tmp_path):
+    """A stash from a failed round must never be consumed later (the
+    finisher closes over rolled-back arrays), and drain/close must release
+    an abandoned stash instead of pinning its buffers."""
+    calls = {"async": 0}
+
+    class Spy:
+        def fits_async(self, n):
+            return True
+
+        def sample_async(self, n, seed=0):
+            calls["async"] += 1
+            return lambda: trainer.sample(n, seed=seed)
+
+    w = SnapshotWriter(trainer.init.global_meta, trainer.init.encoders,
+                       lambda e: str(tmp_path / f"d_{e}.csv"), rows=32)
+    spy = Spy()
+    with w:
+        w.predispatch(7, spy)
+        w.discard_predispatch()        # trainer rollback path
+        w(7, spy)                      # must dispatch FRESH, not consume stale
+        assert calls["async"] == 2
+        w.predispatch(8, spy)          # left unconsumed at close
+        assert calls["async"] == 3
+    assert w._pre is None              # close() drained the stash
+    assert os.path.exists(tmp_path / "d_7.csv")
+    assert not os.path.exists(tmp_path / "d_8.csv")
