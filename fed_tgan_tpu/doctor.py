@@ -153,6 +153,68 @@ def check_compile_cache() -> bool:
                  f"{sub} ({n} entries, machine fingerprint {fp})")
 
 
+def wait_healthy(timeout_min: float = 0.0, quiet_min: float = 45.0,
+                 probe_timeout_s: int = 120,
+                 _probe=None, _load=None, _sleep=None, _log=print) -> bool:
+    """Block until the accelerator backend answers a probe.
+
+    Returns True the moment a probe succeeds, False when ``timeout_min``
+    (0 = wait forever) elapses first.  Encodes the observed wedge model of
+    the tunneled backend (PARITY.md): a probe killed mid-handshake (e.g.
+    slow only because the host is loaded) can wedge the tunnel, and a
+    wedged tunnel heals only after a sustained quiet period with no
+    connection attempts.  So this waiter never probes while the 1-min load
+    average is >= 1.0 (defer 2 min instead), and after a failed probe it
+    holds a ``quiet_min``-minute quiet window rather than hammering the
+    backend — probing more often can keep the wedge alive.
+
+    ``_probe``/``_load``/``_sleep``/``_log`` are test seams.
+    """
+    import time as _time
+
+    from fed_tgan_tpu.parallel.mesh import probe_backend_responsive
+
+    # ignore_cache: a stamp from before a fresh wedge must not let the
+    # waiter vouch for a backend it never contacted
+    probe = _probe or (
+        lambda: probe_backend_responsive(timeout_s=probe_timeout_s,
+                                         ignore_cache=True))
+    load = _load or (lambda: os.getloadavg()[0])
+    sleep = _sleep or _time.sleep
+    # one busy CPU on a many-core host is idle for probing purposes
+    busy_at = max(1.0, 0.75 * (os.cpu_count() or 1))
+    deadline = (_time.monotonic() + timeout_min * 60.0) if timeout_min > 0 \
+        else None
+
+    def pause(seconds: float) -> bool:
+        """Sleep, capped to the remaining deadline; False = deadline hit."""
+        if deadline is not None:
+            seconds = min(seconds, deadline - _time.monotonic())
+            if seconds <= 0:
+                return False
+        sleep(seconds)
+        return deadline is None or _time.monotonic() < deadline
+
+    while True:
+        cur = load()
+        if cur >= busy_at:
+            _log(f"doctor: host busy (load {cur:.2f} >= {busy_at:.2f}); "
+                 "deferring probe 2 min")
+            if not pause(120):
+                break
+            continue
+        ok, reason = probe()
+        if ok:
+            _log("doctor: accelerator backend healthy")
+            return True
+        _log(f"doctor: probe failed ({reason}); "
+             f"quiet window {quiet_min:.1f} min")
+        if not pause(quiet_min * 60.0):
+            break
+    _log("doctor: wait-healthy timed out")
+    return False
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -161,6 +223,16 @@ def main(argv=None) -> int:
                     "bottom-up; exit 0 = all checks passed")
     ap.add_argument("--probe-timeout", type=int, default=120,
                     help="accelerator probe timeout in seconds")
+    ap.add_argument("--wait-healthy", action="store_true",
+                    help="instead of the one-shot diagnosis, block until "
+                         "the accelerator backend answers a probe (wedge-"
+                         "aware: defers under host load, holds long quiet "
+                         "windows between failed probes); exit 0 = healthy")
+    ap.add_argument("--wait-timeout-min", type=float, default=0.0,
+                    help="--wait-healthy: give up after this many minutes "
+                         "(0 = wait forever)")
+    ap.add_argument("--quiet-window-min", type=float, default=45.0,
+                    help="--wait-healthy: quiet window after a failed probe")
     ap.add_argument("--mesh-devices", type=int, default=2,
                     help="virtual CPU mesh size for the collective check")
     ap.add_argument("--backend", choices=["cpu"], default=None,
@@ -170,6 +242,12 @@ def main(argv=None) -> int:
                          "pin, not the env var — on site-hooked hosts the "
                          "env var does not reach a fresh interpreter")
     args = ap.parse_args(argv)
+    if args.wait_healthy:
+        return 0 if wait_healthy(
+            timeout_min=args.wait_timeout_min,
+            quiet_min=args.quiet_window_min,
+            probe_timeout_s=args.probe_timeout,
+        ) else 1
     if args.backend == "cpu":
         import jax
 

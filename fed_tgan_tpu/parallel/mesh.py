@@ -51,6 +51,7 @@ def probe_backend_responsive(
     attempts: int = 1,
     backoff_s: float = 60.0,
     log=None,
+    ignore_cache: bool = False,
 ) -> tuple[bool, str]:
     """Whether ``jax.devices()`` completes in a fresh interpreter.
 
@@ -87,15 +88,19 @@ def probe_backend_responsive(
 
     cache_s = 300
     stamp = _probe_stamp_path()
-    try:
-        st = os.lstat(stamp)  # lstat: never trust a symlinked stamp
-        import stat as _stat
+    if not ignore_cache:
+        # ``ignore_cache``: callers whose whole point is CURRENT liveness
+        # (doctor --wait-healthy gating a relaunch) must not be vouched for
+        # by a stamp that may predate a fresh wedge
+        try:
+            st = os.lstat(stamp)  # lstat: never trust a symlinked stamp
+            import stat as _stat
 
-        if (_stat.S_ISREG(st.st_mode) and st.st_uid == os.getuid()
-                and time.time() - st.st_mtime < cache_s):
-            return True, "cached"
-    except OSError:
-        pass
+            if (_stat.S_ISREG(st.st_mode) and st.st_uid == os.getuid()
+                    and time.time() - st.st_mtime < cache_s):
+                return True, "cached"
+        except OSError:
+            pass
 
     reason = ""
     for attempt in range(1, max(1, attempts) + 1):

@@ -361,3 +361,29 @@ def test_bench_deadline_kills_registered_children(monkeypatch):
         bench._DEADLINE_CHILDREN.remove(child)
         if child.poll() is None:
             child.kill()
+
+
+def test_probe_ignore_cache_bypasses_fresh_stamp(monkeypatch, tmp_path):
+    """doctor --wait-healthy gates relaunches on CURRENT liveness: a fresh
+    success stamp (which may predate a new wedge) must not satisfy a probe
+    called with ignore_cache=True."""
+    import pathlib
+    import subprocess
+    import tempfile
+
+    import fed_tgan_tpu.parallel.mesh as mesh
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    pathlib.Path(mesh._probe_stamp_path()).touch()  # fresh stamp
+
+    calls = {"n": 0}
+
+    def counting_run(*a, **kw):
+        calls["n"] += 1
+        return subprocess.CompletedProcess(a, 0, "", "")
+
+    monkeypatch.setattr(subprocess, "run", counting_run)
+    ok, reason = mesh.probe_backend_responsive(timeout_s=1)
+    assert ok and reason == "cached" and calls["n"] == 0  # cache honored
+    ok, reason = mesh.probe_backend_responsive(timeout_s=1, ignore_cache=True)
+    assert ok and reason != "cached" and calls["n"] == 1  # real probe forced

@@ -80,3 +80,54 @@ def test_doctor_cli_all_green_on_cpu(tmp_path):
     for name in ("runtime", "backend", "virtual-mesh", "transport",
                  "compile-cache"):
         assert f"OK   {name}" in proc.stdout, proc.stdout
+
+
+def test_doctor_wait_healthy_policy():
+    """The waiter defers under load, holds a quiet window after a failed
+    probe, returns True the moment a probe succeeds, and never probes
+    while busy (the load-race kill is the suspected wedge trigger)."""
+    from fed_tgan_tpu.doctor import wait_healthy
+
+    loads = iter([2.5, 0.2, 0.1])           # busy once, then idle
+    probes = iter([(False, "hung"), (True, "")])
+    sleeps, logs = [], []
+    ok = wait_healthy(
+        timeout_min=0.0, quiet_min=45.0,
+        _probe=lambda: next(probes),
+        _load=lambda: next(loads),
+        _sleep=sleeps.append,
+        _log=logs.append,
+    )
+    assert ok
+    assert sleeps == [120, 45 * 60.0]        # busy defer, then quiet window
+    assert any("busy" in l for l in logs)
+    assert any("quiet window" in l for l in logs)
+    assert "doctor: accelerator backend healthy" in logs
+
+
+def test_doctor_wait_healthy_times_out():
+    from fed_tgan_tpu.doctor import wait_healthy
+
+    clock = {"t": 0.0}
+
+    def sleep(s):
+        clock["t"] += s
+
+    import time
+
+    real = time.monotonic
+    time.monotonic = lambda: real() * 0 + clock["t"]
+    try:
+        ok = wait_healthy(
+            timeout_min=1.0, quiet_min=2.0,
+            _probe=lambda: (False, "hung"),
+            _load=lambda: 0.0,
+            _sleep=sleep,
+            _log=lambda m: None,
+        )
+    finally:
+        time.monotonic = real
+    assert not ok
+    # sleeps are capped to the remaining deadline: a 2-min quiet window
+    # must not overshoot the 1-min timeout
+    assert clock["t"] <= 60.0
