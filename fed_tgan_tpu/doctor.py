@@ -163,10 +163,11 @@ def wait_healthy(timeout_min: float = 0.0, quiet_min: float = 45.0,
     the tunneled backend (PARITY.md): a probe killed mid-handshake (e.g.
     slow only because the host is loaded) can wedge the tunnel, and a
     wedged tunnel heals only after a sustained quiet period with no
-    connection attempts.  So this waiter never probes while the 1-min load
-    average is >= 1.0 (defer 2 min instead), and after a failed probe it
-    holds a ``quiet_min``-minute quiet window rather than hammering the
-    backend — probing more often can keep the wedge alive.
+    connection attempts.  So this waiter never probes while the host is
+    busy — 1-min load average >= max(1, 0.75 x CPU count), i.e. most cores
+    occupied (defer 2 min instead) — and after a failed probe it holds a
+    ``quiet_min``-minute quiet window rather than hammering the backend:
+    probing more often can keep the wedge alive.
 
     ``_probe``/``_load``/``_sleep``/``_log`` are test seams.
     """

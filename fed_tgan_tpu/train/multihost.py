@@ -317,43 +317,53 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
             models_g, metrics, chain, _finite = epoch_fns[size](
                 models_g, data_g, cond_g, rows_g, steps_g, weights_g, chain
             )
+            last = e + size - 1
+            finish = None
+            snap_due = sender is not None and last in firing
+            if snap_due and sampler.fits_async(run.sample_rows):
+                # pre-sync snapshot dispatch (same contract as
+                # FederatedTrainer.fit): slice the replicated post-psum G
+                # from the STILL IN-FLIGHT chunk output on-device (the old
+                # numpy local_shard here forced a sync + D2H + re-upload)
+                # and queue generation behind the chunk, so the device runs
+                # train -> sample back-to-back.  This window is concurrent
+                # with the chunk still executing on device, so it stays
+                # inside the chunk's reported wall-clock.
+                sender.throttle()  # bound live result buffers FIRST
+                dev_shard = lambda t: jax.tree.map(  # noqa: E731
+                    lambda l: l.addressable_shards[0].data[0], t)
+                finish = sampler.sample_async(
+                    dev_shard(models_g.params_g), dev_shard(models_g.state_g),
+                    pooled_cond, run.sample_rows,
+                    jax.random.key(run.seed + last + 29),
+                )
             jax.block_until_ready(models_g)
             seconds = time.time() - t0
-            last = e + size - 1
 
             if sender is not None:
                 # rank 1 is the reporting participant: post-psum state is
                 # replicated, so its shard is the global model
                 msg = {"type": "chunk", "rounds": size, "seconds": seconds,
                        "last": last}
-                finish = None
                 if last in firing and decode_tables is not None:
                     # denorm tables ride the FIRST snapshot message only
                     msg["decode_tables"] = decode_tables
                     decode_tables = None
-                if last in firing:
-                    params_g = local_shard(models_g.params_g)
-                    state_g = local_shard(models_g.state_g)
-                    key = jax.random.key(run.seed + last + 29)
-                    # ship the quantized packed parts — the TCP hop
-                    # benefits from the small layout exactly like the D2H
-                    # transfer does; rank 0 denormalizes with the tables
-                    # from the first snapshot message.  Dispatch now
-                    # (training thread), finish the copy on the sender
-                    # worker; oversized requests fall back to the
-                    # memory-bounded synchronous sample.
+                if snap_due and finish is None:
+                    # oversized request: the memory-bounded synchronous
+                    # sample, after the sync (it blocks on transfers anyway)
                     sender.throttle()  # bound live result buffers FIRST
-                    if sampler.fits_async(run.sample_rows):
-                        finish = sampler.sample_async(
-                            params_g, state_g, pooled_cond,
-                            run.sample_rows, key,
-                        )
-                    else:
-                        parts = sampler.sample(
-                            params_g, state_g, pooled_cond,
-                            run.sample_rows, key,
-                        )
-                        finish = lambda parts=parts: parts  # noqa: E731
+                    parts = sampler.sample(
+                        local_shard(models_g.params_g),
+                        local_shard(models_g.state_g),
+                        pooled_cond, run.sample_rows,
+                        jax.random.key(run.seed + last + 29),
+                    )
+                    finish = lambda parts=parts: parts  # noqa: E731
+                # ship the quantized packed parts — the TCP hop benefits
+                # from the small layout exactly like the D2H transfer does;
+                # rank 0 denormalizes with the tables from the first
+                # snapshot message
                 sender.send(msg, finish)
             if save_due(last):
                 _save_participant(run, transport.rank, models_g, chain,

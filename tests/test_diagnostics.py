@@ -82,12 +82,17 @@ def test_doctor_cli_all_green_on_cpu(tmp_path):
         assert f"OK   {name}" in proc.stdout, proc.stdout
 
 
-def test_doctor_wait_healthy_policy():
+def test_doctor_wait_healthy_policy(monkeypatch):
     """The waiter defers under load, holds a quiet window after a failed
     probe, returns True the moment a probe succeeds, and never probes
     while busy (the load-race kill is the suspected wedge trigger)."""
+    import os
+
     from fed_tgan_tpu.doctor import wait_healthy
 
+    # the busy threshold scales with CPU count; pin it so the load values
+    # below mean the same thing on any machine
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
     loads = iter([2.5, 0.2, 0.1])           # busy once, then idle
     probes = iter([(False, "hung"), (True, "")])
     sleeps, logs = [], []
