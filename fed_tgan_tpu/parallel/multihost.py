@@ -108,7 +108,18 @@ def from_local_chunk(mesh: Mesh, tree):
 def local_shard(tree):
     """Each leaf's process-local shard with the clients axis squeezed —
     the participant's own view of a mesh-sharded result (post-psum model
-    state is replicated, so any participant's shard is the global value)."""
+    state is replicated, so any participant's shard is the global value).
+    Materializes to numpy (blocks until the value is ready)."""
     return jax.tree.map(
         lambda leaf: np.asarray(leaf.addressable_shards[0].data)[0], tree
+    )
+
+
+def local_shard_device(tree):
+    """``local_shard`` without leaving the device: the slice is dispatched
+    asynchronously on the shard's device, so it composes with still-in-
+    flight producers (the pre-sync snapshot dispatch) instead of forcing a
+    sync + device-to-host copy + re-upload."""
+    return jax.tree.map(
+        lambda leaf: leaf.addressable_shards[0].data[0], tree
     )

@@ -240,6 +240,33 @@ class RoundBookkeeping:
             self.phase_times["distribution"][-1] = pre_hook_s + t_hook
             self.epoch_times[-1] = t_round + pre_hook_s + t_hook
 
+    def _maybe_predispatch(self, sample_hook, epoch: int,
+                           on_nonfinite: str) -> float:
+        """Fire the hook's pre-sync snapshot dispatch (if it offers one);
+        returns its wall cost, which callers book to the distribution phase
+        via ``pre_hook_s``.  Skipped under on_nonfinite="raise" — don't
+        sample a model the divergence check may reject."""
+        if (sample_hook is None or on_nonfinite == "raise"
+                or not hasattr(sample_hook, "predispatch")):
+            return 0.0
+        t0 = time.time()
+        sample_hook.predispatch(epoch, self)
+        return time.time() - t0
+
+    def _sync_or_rollback(self, arrays, rollback, sample_hook) -> None:
+        """block_until_ready with the shared failure contract: on a device/
+        runtime failure the chunk's outputs are error-poisoned, so restore
+        last-good state (``rollback``) and drop any predispatched snapshot
+        of the poisoned arrays before re-raising."""
+        try:
+            jax.block_until_ready(arrays)
+        except Exception:
+            rollback()
+            discard = getattr(sample_hook, "discard_predispatch", None)
+            if discard is not None:
+                discard()
+            raise
+
     def _check_finite(self, metrics, first_epoch: int, mode: str) -> None:
         """Divergence detection (the reference has none, SURVEY §5.3): flags
         non-finite losses (WGAN-GP blow-ups) right after the device program
@@ -446,38 +473,21 @@ class FederatedTrainer(RoundBookkeeping):
             # arrays; a DEVICE failure rolls back to last-good below
             self.models = models
             last = e + size - 1
-            t_pre = 0.0
-            if (last in firing and on_nonfinite != "raise"
-                    and hasattr(sample_hook, "predispatch")):
-                # queue the snapshot's generation program behind the chunk
-                # BEFORE the host sync: the device goes train -> sample
-                # back-to-back instead of idling a host round trip.  Skipped
-                # under on_nonfinite="raise" (don't sample a model the check
-                # below may reject); the hook's normal call then dispatches.
-                # Its wall cost (usually microseconds of dispatch, but the
-                # writer's backpressure can block here) is measured and
-                # booked to the distribution phase, not the chunk.
-                _t = time.time()
-                sample_hook.predispatch(last, self)
-                t_pre = time.time() - _t
+            # queue the snapshot's generation program behind the chunk
+            # BEFORE the host sync: the device goes train -> sample
+            # back-to-back instead of idling a host round trip
+            t_pre = self._maybe_predispatch(
+                sample_hook if last in firing else None, last, on_nonfinite)
             # epoch_times feeds timestamp_experiment.csv — must measure the
             # chunk's real wall-clock, not async dispatch latency.  The sync
             # must come BEFORE bool(finite): a runtime failure poisons every
             # chunk output including the scalar, and only this sync has the
             # rollback handler
-            try:
-                jax.block_until_ready(models)
-            except Exception:
-                # device/runtime failure mid-chunk: the chunk's arrays are
-                # error-poisoned — roll BOTH models and key chain back to
-                # the last-good pair so an error handler's checkpoint saves
-                # a consistent, materializable state; a predispatched
-                # snapshot of the poisoned arrays must never be consumed
+
+            def _rollback(prev=prev):
                 self.models, self._key = prev
-                discard = getattr(sample_hook, "discard_predispatch", None)
-                if discard is not None:
-                    discard()
-                raise
+
+            self._sync_or_rollback(models, _rollback, sample_hook)
             ok = on_nonfinite == "ignore" or bool(finite)
             if not ok:
                 self._check_finite(metrics, e, on_nonfinite)

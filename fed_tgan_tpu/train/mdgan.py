@@ -310,22 +310,12 @@ class MDGANTrainer(RoundBookkeeping):
             # host round trip between (same contract as FederatedTrainer)
             self.gen, self.disc = gen, disc
             e = self.completed_epochs
-            t_pre = 0.0
-            if (sample_hook is not None and on_nonfinite != "raise"
-                    and hasattr(sample_hook, "predispatch")):
-                _t = time.time()
-                sample_hook.predispatch(e, self)
-                t_pre = time.time() - _t
-            try:
-                jax.block_until_ready(gen)
-            except Exception:
-                # chunk arrays are error-poisoned: roll back to last-good;
-                # a predispatched snapshot of them must never be consumed
+            t_pre = self._maybe_predispatch(sample_hook, e, on_nonfinite)
+
+            def _rollback(prev=prev):
                 self.gen, self.disc, self._key = prev
-                discard = getattr(sample_hook, "discard_predispatch", None)
-                if discard is not None:
-                    discard()
-                raise
+
+            self._sync_or_rollback(gen, _rollback, sample_hook)
             # single-scalar divergence check; full metric arrays cross to
             # host only on the failure path (to name the bad round)
             if on_nonfinite != "ignore" and not bool(finite):

@@ -37,6 +37,7 @@ from fed_tgan_tpu.ops.segments import SegmentSpec
 from fed_tgan_tpu.parallel.multihost import (
     from_local_chunk,
     local_shard,
+    local_shard_device,
     participant_mesh,
 )
 from fed_tgan_tpu.train.federated import RoundBookkeeping, _pad_to, make_federated_epoch
@@ -330,10 +331,9 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                 # with the chunk still executing on device, so it stays
                 # inside the chunk's reported wall-clock.
                 sender.throttle()  # bound live result buffers FIRST
-                dev_shard = lambda t: jax.tree.map(  # noqa: E731
-                    lambda l: l.addressable_shards[0].data[0], t)
                 finish = sampler.sample_async(
-                    dev_shard(models_g.params_g), dev_shard(models_g.state_g),
+                    local_shard_device(models_g.params_g),
+                    local_shard_device(models_g.state_g),
                     pooled_cond, run.sample_rows,
                     jax.random.key(run.seed + last + 29),
                 )
