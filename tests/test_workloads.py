@@ -34,7 +34,10 @@ def _adult_like(n=2400, seed=0):
     })
 
 
-def _covertype_like(n=2000, seed=1):
+def _covertype_like_small(n=2000, seed=1):
+    # 4-column miniature using the real Covertype column names (matches the
+    # COVERTYPE preset); bench._covertype_like is the full-schema variant
+    # the scale workload uses
     rng = np.random.default_rng(seed)
     cover = rng.integers(1, 8, n)  # 7 classes
     return pd.DataFrame({
@@ -84,7 +87,7 @@ def test_adult_noniid_dirichlet_8clients():
 
 @pytest.mark.slow
 def test_covertype_32clients_4_per_device_with_utility():
-    df = _covertype_like()
+    df = _covertype_like_small()
     frames = shard_dataframe(df, 32, "iid", seed=5)
     clients = [
         TablePreprocessor(
@@ -113,3 +116,17 @@ def test_covertype_32clients_4_per_device_with_utility():
     # 2 epochs won't match real utility; the protocol must just run and
     # produce the reference-shaped report
     assert len(res["real"]) == 4 and np.isfinite(res["delta_f1"])
+
+
+def test_bench_scale_workload_small():
+    """bench_scale (BASELINE config 5's shape) end-to-end at test size:
+    synthetic Covertype-like table, clients stacked k-per-device, jax-BGM
+    init, fused snapshot-free rounds."""
+    import importlib
+
+    bench = importlib.import_module("bench")
+    out = bench.bench_scale(epochs=2, n_clients=8, rows=4800,
+                            bgm_backend="jax")
+    assert out["value"] > 0
+    assert out["steps_per_client_per_round"] >= 0
+    assert "covertype_scale_8client_4800row" in out["metric"]
