@@ -187,3 +187,31 @@ def test_small_shard_rejected(toy_frame, toy_spec):
                             batch_size=100, pac=4)
     with pytest.raises(ValueError, match="fewer than batch_size"):
         FederatedTrainer(init, config=big_batch, seed=0)
+
+
+def test_sync_or_rollback_restores_state_and_discards_stash():
+    """A failed device sync must roll state back via the callback, drop any
+    predispatched snapshot stash, and re-raise the original error."""
+    import pytest
+
+    from fed_tgan_tpu.train.federated import RoundBookkeeping
+
+    class Boom:
+        def block_until_ready(self):
+            raise RuntimeError("device wedged mid-chunk")
+
+    bk = RoundBookkeeping()
+    calls = []
+
+    class Hook:
+        def discard_predispatch(self):
+            calls.append("discard")
+
+    with pytest.raises(RuntimeError, match="device wedged"):
+        bk._sync_or_rollback(Boom(), lambda: calls.append("rollback"), Hook())
+    assert calls == ["rollback", "discard"]  # rollback before discard
+
+    # hooks without the contract (plain callables / None) are fine
+    with pytest.raises(RuntimeError):
+        bk._sync_or_rollback(Boom(), lambda: calls.append("rb2"), None)
+    assert calls[-1] == "rb2"
