@@ -160,7 +160,7 @@ def _arm_run_deadline(workload: str, tag: str, epochs: int = 500,
 
 
 def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
-           bgm_backend: str = "sklearn", df=None):
+           bgm_backend: str = "sklearn", df=None, batch_size: int = 500):
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -182,7 +182,9 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
     init = federated_initialize(
         clients, seed=seed, weighted=weighted, backend=bgm_backend
     )
-    trainer = FederatedTrainer(init, config=TrainConfig(), seed=seed)
+    trainer = FederatedTrainer(
+        init, config=TrainConfig(batch_size=batch_size), seed=seed
+    )
     return df, init, trainer
 
 
@@ -301,7 +303,8 @@ def _val_synth_f1(synth, val, reference_frame, target, categorical) -> float:
 
 def bench_utility(epochs: int = 500, n_clients: int = 2,
                   weighted: bool = True, bgm_backend: str = "sklearn",
-                  select: str = "none", train_rows: int | None = None) -> dict:
+                  select: str = "none", train_rows: int | None = None,
+                  batch_size: int = 500) -> dict:
     """Driver-reproducible ΔF1: the reference utility_analysis protocol
     (reference Server/utility_analysis.py:94-119, README.md:67 headline
     0.0850 at 500 epochs on the FULL training CSV).
@@ -348,7 +351,7 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     gan_df = train_df if train_rows is None else train_df.iloc[:train_rows]
     _, init, trainer = _setup(
         n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend,
-        df=gan_df,
+        df=gan_df, batch_size=batch_size,
     )
     cols = init.global_meta.column_names
     real_train = train_df[cols]
@@ -451,6 +454,8 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         suffix += f"({select}-selected round {best_round})"
     if train_rows is not None:
         suffix += f"(gan_rows={train_rows})"
+    if batch_size != 500:
+        suffix += f"(batch={batch_size})"
     return {
         "metric": f"intrusion_{n_clients}client_delta_f1_at_{epochs}{suffix}",
         "value": round(float(u["delta_f1"]), 4),
@@ -670,6 +675,12 @@ def main() -> int:
                     help="utility workload: GAN trains on this prefix of "
                          "the train split (classifier protocol unchanged) "
                          "— the PARITY.md data-size ablation")
+    ap.add_argument("--batch-size", type=int, default=500,
+                    help="utility workload: CTGAN batch size (reference "
+                         "default 500; an epoch is rows//batch steps per "
+                         "client, so smaller batches raise the step budget "
+                         "at a fixed epoch horizon — the small-sample "
+                         "lever for the surviving 7k-row table)")
     ap.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                     help="round workload: capture a jax.profiler trace of "
                          "the measured rounds into DIR")
@@ -687,6 +698,11 @@ def main() -> int:
                          "workload defaults to jax (32 clients of serial "
                          "sklearn fits would dominate the demo)")
     args = ap.parse_args()
+    if args.batch_size <= 0 or args.batch_size % 10:
+        ap.error(f"--batch-size {args.batch_size}: must be a positive "
+                 "multiple of pac=10 (the discriminator packs rows in "
+                 "groups of 10, reference Server/dtds/synthesizers/"
+                 "ctgan.py:28-30)")
     bgm = args.bgm_backend or (
         "jax" if args.workload == "scale" else "sklearn")
     clients = args.clients if args.clients is not None else (
@@ -726,7 +742,7 @@ def main() -> int:
         out = bench_utility(
             epochs, n_clients=clients, weighted=not args.uniform,
             bgm_backend=bgm, select=args.select,
-            train_rows=args.train_rows,
+            train_rows=args.train_rows, batch_size=args.batch_size,
         )
     elif args.workload == "multihost":
         out = bench_multihost(epochs)

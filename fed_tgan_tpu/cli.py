@@ -424,7 +424,14 @@ def _enable_compile_cache() -> None:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.batch_size <= 0 or args.batch_size % 10:
+        parser.error(f"--batch-size {args.batch_size}: must be a positive "
+                     "multiple of pac=10 (the discriminator packs rows in "
+                     "groups of 10, reference Server/dtds/synthesizers/"
+                     "ctgan.py:28-30)")
 
     if args.decode:
         # the trainers read the selection at construction time via

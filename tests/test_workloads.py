@@ -130,3 +130,23 @@ def test_bench_scale_workload_small():
     assert out["value"] > 0
     assert out["steps_per_client_per_round"] >= 0
     assert "covertype_scale_8client_4800row" in out["metric"]
+
+
+def test_bench_setup_batch_size_raises_step_budget():
+    """`bench.py --workload utility --batch-size N` is the small-sample
+    lever for the 500-epoch ΔF1 horizon: an epoch is rows//batch steps per
+    client (reference semantics, Server/dtds/distributed.py:304), so a
+    smaller batch trains more steps at the same epoch count.  Verify the
+    flag reaches TrainConfig and the per-client step budget scales."""
+    import importlib
+
+    import pandas as pd
+
+    bench = importlib.import_module("bench")
+    df = pd.read_csv(bench.CSV_PATH).head(600)
+    _, init, t150 = bench._setup(df=df, batch_size=150)
+    t50 = FederatedTrainer(init, config=TrainConfig(batch_size=50), seed=0)
+    assert t150.cfg.batch_size == 150 and t50.cfg.batch_size == 50
+    # 600 rows over 2 iid clients -> 300 each: 300//150=2 vs 300//50=6
+    assert list(t150.steps) == [2, 2]
+    assert list(t50.steps) == [6, 6]
