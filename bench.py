@@ -160,7 +160,8 @@ def _arm_run_deadline(workload: str, tag: str, epochs: int = 500,
 
 
 def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
-           bgm_backend: str = "sklearn", df=None, batch_size: int = 500):
+           bgm_backend: str = "sklearn", df=None, batch_size: int = 500,
+           ema_decay: float = 0.0):
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -183,7 +184,8 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
         clients, seed=seed, weighted=weighted, backend=bgm_backend
     )
     trainer = FederatedTrainer(
-        init, config=TrainConfig(batch_size=batch_size), seed=seed
+        init, config=TrainConfig(batch_size=batch_size, ema_decay=ema_decay),
+        seed=seed,
     )
     return df, init, trainer
 
@@ -304,7 +306,7 @@ def _val_synth_f1(synth, val, reference_frame, target, categorical) -> float:
 def bench_utility(epochs: int = 500, n_clients: int = 2,
                   weighted: bool = True, bgm_backend: str = "sklearn",
                   select: str = "none", train_rows: int | None = None,
-                  batch_size: int = 500) -> dict:
+                  batch_size: int = 500, ema_decay: float = 0.0) -> dict:
     """Driver-reproducible ΔF1: the reference utility_analysis protocol
     (reference Server/utility_analysis.py:94-119, README.md:67 headline
     0.0850 at 500 epochs on the FULL training CSV).
@@ -351,7 +353,7 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     gan_df = train_df if train_rows is None else train_df.iloc[:train_rows]
     _, init, trainer = _setup(
         n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend,
-        df=gan_df, batch_size=batch_size,
+        df=gan_df, batch_size=batch_size, ema_decay=ema_decay,
     )
     cols = init.global_meta.column_names
     real_train = train_df[cols]
@@ -456,6 +458,8 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         suffix += f"(gan_rows={train_rows})"
     if batch_size != 500:
         suffix += f"(batch={batch_size})"
+    if ema_decay > 0:
+        suffix += f"(ema={ema_decay})"
     return {
         "metric": f"intrusion_{n_clients}client_delta_f1_at_{epochs}{suffix}",
         "value": round(float(u["delta_f1"]), 4),
@@ -681,6 +685,10 @@ def main() -> int:
                          "client, so smaller batches raise the step budget "
                          "at a fixed epoch horizon — the small-sample "
                          "lever for the surviving 7k-row table)")
+    ap.add_argument("--ema-decay", type=float, default=0.0,
+                    help="utility workload: per-round EMA of the aggregated "
+                         "generator; sampling/eval use the smoothed model "
+                         "(0 = off, the reference protocol)")
     ap.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                     help="round workload: capture a jax.profiler trace of "
                          "the measured rounds into DIR")
@@ -703,6 +711,12 @@ def main() -> int:
                  "multiple of pac=10 (the discriminator packs rows in "
                  "groups of 10, reference Server/dtds/synthesizers/"
                  "ctgan.py:28-30)")
+    if not 0.0 <= args.ema_decay < 1.0:
+        ap.error(f"--ema-decay {args.ema_decay}: must be in [0, 1)")
+    if args.ema_decay > 0 and args.select != "none":
+        ap.error("--ema-decay and --select are mutually exclusive: EMA "
+                 "replaces snapshot selection with continuous smoothing, "
+                 "and the selection modes stash/restore raw model state")
     bgm = args.bgm_backend or (
         "jax" if args.workload == "scale" else "sklearn")
     clients = args.clients if args.clients is not None else (
@@ -743,6 +757,7 @@ def main() -> int:
             epochs, n_clients=clients, weighted=not args.uniform,
             bgm_backend=bgm, select=args.select,
             train_rows=args.train_rows, batch_size=args.batch_size,
+            ema_decay=args.ema_decay,
         )
     elif args.workload == "multihost":
         out = bench_multihost(epochs)

@@ -90,6 +90,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-virtual-devices", type=int, default=8)
     p.add_argument("--batch-size", type=int, default=500)
     p.add_argument("--embedding-dim", type=int, default=128)
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="per-round EMA of the aggregated generator "
+                        "(fedavg single-program mode only); snapshots, "
+                        "monitor and saved models use the smoothed "
+                        "generator.  0 = off (reference protocol)")
     p.add_argument("--sample-rows", type=int, default=40000)
     p.add_argument("--monitor-every", type=int, default=0,
                    help="rounds between on-device Avg_JSD/Avg_WD probes "
@@ -432,6 +437,13 @@ def main(argv=None) -> int:
                      "multiple of pac=10 (the discriminator packs rows in "
                      "groups of 10, reference Server/dtds/synthesizers/"
                      "ctgan.py:28-30)")
+    if not 0.0 <= args.ema_decay < 1.0:
+        parser.error(f"--ema-decay {args.ema_decay}: must be in [0, 1)")
+    if args.ema_decay > 0 and (
+            args.mode != "fedavg" or (args.rank is not None and args.ip)):
+        parser.error("--ema-decay is only supported in the single-program "
+                     "fedavg mode (not mdgan/standalone or the "
+                     "multi-process launch)")
 
     if args.decode:
         # the trainers read the selection at construction time via
@@ -542,7 +554,9 @@ def main(argv=None) -> int:
             print(f"client {i}: input is missing columns {missing}")
             return 2
     columns = list(selected) if selected else list(frames[0].columns)
-    cfg = TrainConfig(batch_size=args.batch_size, embedding_dim=args.embedding_dim)
+    cfg = TrainConfig(batch_size=args.batch_size,
+                      embedding_dim=args.embedding_dim,
+                      ema_decay=args.ema_decay)
     if args.mode == "standalone":
         # no participants, no harmonization/refit protocol — skip the
         # federated construction entirely

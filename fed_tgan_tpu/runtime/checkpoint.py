@@ -29,7 +29,13 @@ import pickle
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2: optional EMA leaves in federated checkpoints
+
+# Federated checkpoints WITHOUT an EMA chain keep writing v1 so older
+# builds still load them; EMA checkpoints write v2, which older builds
+# refuse cleanly (their loader guards version > 1) instead of silently
+# dropping the EMA leaves and resuming a different run.
+_V1 = 1
 
 _HOST = "host.pkl"
 _ARRAYS = "arrays.npz"
@@ -66,8 +72,11 @@ def save_federated(trainer, path: str, run_name: str | None = None) -> None:
             f"save_federated expects a FederatedTrainer or MDGANTrainer, "
             f"got {type(trainer).__name__}"
         )
+    has_ema = not is_mdgan and getattr(trainer, "ema", None) is not None
     host = {
-        "version": FORMAT_VERSION,
+        "version": FORMAT_VERSION if has_ema else _V1,
+        "ema": has_ema,
+        "ema_updates": getattr(trainer, "_ema_updates", 0),
         "kind": "mdgan" if is_mdgan else "federated",
         "init": trainer.init,
         "cfg": trainer.cfg,
@@ -85,7 +94,14 @@ def save_federated(trainer, path: str, run_name: str | None = None) -> None:
     }
     with open(os.path.join(path, _HOST), "wb") as f:
         pickle.dump(host, f)
-    state = (trainer.gen, trainer.disc) if is_mdgan else trainer.models
+    if is_mdgan:
+        state = (trainer.gen, trainer.disc)
+    elif has_ema:
+        # EMA runs (cfg.ema_decay > 0) persist the smoothed generator too —
+        # resume must continue the same EMA chain bit-exactly
+        state = (trainer.models, trainer.ema)
+    else:
+        state = trainer.models
     _save_leaves(
         state,
         {"rng_key": jax.random.key_data(trainer._key)},
@@ -122,6 +138,18 @@ def load_federated(path: str, mesh=None):
             trainer.gen, trainer.disc = _load_leaves(
                 (trainer.gen, trainer.disc), data
             )
+        elif getattr(trainer, "ema", None) is not None:
+            # cfg.ema_decay > 0 (cfg rides in the checkpoint), so the
+            # rebuilt trainer has an EMA template matching the saved layout
+            if not host.get("ema"):
+                raise ValueError(
+                    f"{path}: cfg.ema_decay > 0 but the checkpoint carries "
+                    "no EMA leaves (saved by a pre-EMA build?)"
+                )
+            trainer.models, trainer.ema = _load_leaves(
+                (trainer.models, trainer.ema), data
+            )
+            trainer._ema_updates = int(host.get("ema_updates", 0))
         else:
             trainer.models = _load_leaves(trainer.models, data)
         trainer._key = jax.random.wrap_key_data(data["rng_key"])
@@ -193,7 +221,9 @@ def save_synthesizer(synth, path: str) -> None:
         transformer = synth.transformer
         key_offset = 17  # StandaloneSynthesizer.sample_encoded's offset
     host = {
-        "version": FORMAT_VERSION,
+        # layout unchanged since v1 (EMA runs bake the debiased generator
+        # into params_g, no extra leaves) — stay loadable on older builds
+        "version": _V1,
         "kind": "synthesizer",
         "cfg": synth.cfg,
         "transformer": transformer,

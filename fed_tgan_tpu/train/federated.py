@@ -165,35 +165,54 @@ def make_federated_epoch(
 
         return jax.vmap(run_one)(models, data, cond, rows, steps_i, jnp.arange(k))
 
-    def epoch_local(models, data, cond, rows, steps_i, weight, key):
+    use_ema = cfg.ema_decay > 0.0
+
+    def epoch_local(models, data, cond, rows, steps_i, weight, key, *ema_in):
         avg = partial(weighted_average, weights=weight)
 
         def round_body(carry, _):
-            models_c, chain = carry
+            models_c, chain, ema_c = carry
             # same split protocol the host loop used, now on device
             chain, rkey = jax.random.split(chain)
             models_c, metrics = one_round(models_c, data, cond, rows, steps_i, rkey)
             # ---- the entire Fed-TGAN communication round: one weighted psum
+            avg_g, avg_sg = avg(models_c.params_g), avg(models_c.state_g)
             models_c = models_c._replace(
-                params_g=replicate_local(avg(models_c.params_g), k),
+                params_g=replicate_local(avg_g, k),
                 params_d=replicate_local(avg(models_c.params_d), k),
-                state_g=replicate_local(avg(models_c.state_g), k),
+                state_g=replicate_local(avg_sg, k),
             )
-            return (models_c, chain), metrics
+            if use_ema:
+                # the psum output is replicated, so the EMA (tracked without
+                # the local k axis) stays replicated too — one generator's
+                # worth of state per device, no extra collective
+                d = cfg.ema_decay
+                ema_c = jax.tree.map(
+                    lambda e_, n: d * e_ + (1.0 - d) * n,
+                    ema_c, (avg_g, avg_sg),
+                )
+            return (models_c, chain, ema_c), metrics
 
-        (models, key), metrics = jax.lax.scan(
-            round_body, (models, key), None, length=rounds
+        ema = ema_in[0] if use_ema else ()
+        (models, key, ema), metrics = jax.lax.scan(
+            round_body, (models, key, ema), None, length=rounds
         )
-        return models, metrics, key, all_finite_flag(metrics)
+        out = (models, metrics, key, all_finite_flag(metrics))
+        return out + (ema,) if use_ema else out
 
     sharded = P(CLIENTS_AXIS)
+    in_specs = [sharded, sharded, sharded, sharded, sharded, sharded, P()]
+    # metrics carry a leading rounds axis; the key chain and the finite
+    # flag are replicated
+    out_specs = [sharded, P(None, CLIENTS_AXIS), P(), P()]
+    if use_ema:
+        in_specs.append(P())   # EMA rides replicated, like the key chain
+        out_specs.append(P())
     fn = jax.shard_map(
         epoch_local,
         mesh=mesh,
-        in_specs=(sharded, sharded, sharded, sharded, sharded, sharded, P()),
-        # metrics carry a leading rounds axis; the key chain and the finite
-        # flag are replicated
-        out_specs=(sharded, P(None, CLIENTS_AXIS), P(), P()),
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs),
         # the fused Pallas activation can't declare per-axis varying-ness on
         # its out_shape; its outputs are strictly per-client row blocks
         check_vma=False,
@@ -377,6 +396,19 @@ class FederatedTrainer(RoundBookkeeping):
             lambda x: np.broadcast_to(np.asarray(x)[None], (n_clients,) + np.shape(x)).copy(),
             one,
         )
+        # EMA of the aggregated generator (cfg.ema_decay > 0): one
+        # generator's worth of (params, BN state).  Zero-seeded and
+        # bias-corrected at read time (`_global_model` divides by 1-d^t),
+        # so at --ema-decay 0.999 the smoothed model is a proper average of
+        # the trajectory instead of staying ~d^t dominated by the random
+        # init.  None when disabled — the epoch program then has the exact
+        # pre-EMA signature and trajectory.
+        self.ema = (
+            jax.tree.map(lambda x: np.zeros_like(np.asarray(x)),
+                         (one.params_g, one.state_g))
+            if self.cfg.ema_decay > 0.0 else None
+        )
+        self._ema_updates = 0  # rounds folded into self.ema (debias power)
 
         self._epoch_fns: dict[int, Any] = {}
         self._device_stacks = None  # uploaded once on first fit()
@@ -447,14 +479,30 @@ class FederatedTrainer(RoundBookkeeping):
         else:
             firing = {x for x in hook_epochs if e <= x < end}
 
+        use_ema = self.ema is not None
+        if use_ema:
+            # commit the EMA to the mesh once, replicated like the key chain
+            self.ema = jax.device_put(
+                self.ema, NamedSharding(self.mesh, P())
+            )
+
         while e < end:
             nxt = min((f for f in firing if f >= e), default=end - 1)
             size = min(nxt - e + 1, max_rounds_per_call, end - e)
-            prev = (self.models, self._key)  # last-good, for a failed sync
+            # last-good, for a failed sync
+            prev = (self.models, self._key, self.ema, self._ema_updates)
             t0 = time.time()
-            models, metrics, self._key, finite = self._epoch_fn_for(size)(
-                models, data, cond, rows, steps, weights, self._key
-            )
+            if use_ema:
+                (models, metrics, self._key, finite,
+                 self.ema) = self._epoch_fn_for(size)(
+                    models, data, cond, rows, steps, weights, self._key,
+                    self.ema,
+                )
+                self._ema_updates += size
+            else:
+                models, metrics, self._key, finite = self._epoch_fn_for(size)(
+                    models, data, cond, rows, steps, weights, self._key
+                )
             # divergence check: ONE scalar crosses to host (fetching it also
             # serves as the chunk's sync point); the full metric arrays are
             # pulled only on the failure path to name the bad round.  State
@@ -485,7 +533,8 @@ class FederatedTrainer(RoundBookkeeping):
             # rollback handler
 
             def _rollback(prev=prev):
-                self.models, self._key = prev
+                (self.models, self._key, self.ema,
+                 self._ema_updates) = prev
 
             self._sync_or_rollback(models, _rollback, sample_hook)
             ok = on_nonfinite == "ignore" or bool(finite)
@@ -511,15 +560,33 @@ class FederatedTrainer(RoundBookkeeping):
 
     # ------------------------------------------------------------ sampling
 
-    def _global_model(self):
-        """Post-aggregation G params/state are replicated; take client 0's."""
+    def _global_model(self, use_ema: bool | None = None):
+        """Post-aggregation G params/state are replicated; take client 0's.
+
+        ``use_ema=None`` means "EMA iff enabled": every sampling surface
+        (snapshots, monitor, utility eval, saved synthesizer) coherently
+        uses the smoothed generator when ``cfg.ema_decay > 0``."""
+        if use_ema is None:
+            # before any round has been folded in, the debiased EMA is
+            # undefined (0/0) — and equals the raw init model anyway
+            use_ema = self.ema is not None and self._ema_updates > 0
+        if use_ema:
+            if self.ema is None:
+                raise ValueError("EMA sampling requested but cfg.ema_decay=0")
+            if self._ema_updates == 0:
+                raise ValueError("EMA sampling requested before any round")
+            # zero-seeded EMA ⇒ Adam-style bias correction: divide by
+            # 1-d^t so early reads are trajectory averages, not init-shrunk
+            scale = 1.0 / (1.0 - self.cfg.ema_decay ** self._ema_updates)
+            return jax.tree.map(lambda x: jnp.asarray(x) * scale, self.ema)
         return (
             jax.tree.map(lambda x: jnp.asarray(x)[0], self.models.params_g),
             jax.tree.map(lambda x: jnp.asarray(x)[0], self.models.state_g),
         )
 
-    def sample_encoded(self, n: int, seed: int = 0) -> np.ndarray:
-        params_g, state_g = self._global_model()
+    def sample_encoded(self, n: int, seed: int = 0,
+                       use_ema: bool | None = None) -> np.ndarray:
+        params_g, state_g = self._global_model(use_ema)
         return self._encoded_cache.sample(
             params_g, state_g, self.server_cond, n, jax.random.key(seed + 29)
         )

@@ -176,6 +176,12 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     Returns the final aggregated model params (host pytrees) after sending
     them to rank 0 for the cross-host equality check.
     """
+    if getattr(cfg, "ema_decay", 0.0) > 0.0:
+        raise NotImplementedError(
+            "generator EMA (cfg.ema_decay > 0) is a single-program "
+            "FederatedTrainer feature; the multi-process trainer does not "
+            "carry the EMA state"
+        )
     spec = SegmentSpec.from_output_info(init_out["transformer"].output_info)
     mesh = participant_mesh()
     n_clients = int(mesh.devices.size)

@@ -48,6 +48,13 @@ class TrainConfig:
     lr: float = 2e-4
     beta1: float = 0.5
     beta2: float = 0.9
+    # Exponential moving average of the aggregated generator (params + BN
+    # state), updated once per federated round on device.  0.0 = off (the
+    # reference has no equivalent; the trajectory is bit-identical to
+    # pre-EMA builds when off).  When on, sampling uses the EMA generator —
+    # a small-sample smoothing lever for the 500-epoch ΔF1 horizon, where
+    # per-round snapshot noise exceeds between-round signal (PARITY.md).
+    ema_decay: float = 0.0
 
 
 class ModelBundle(NamedTuple):
