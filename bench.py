@@ -77,6 +77,36 @@ def _ensure_responsive_backend() -> str:
     return "(cpu-fallback)"
 
 
+_EVIDENCE_MAX_AGE_S = 24 * 3600.0  # one round horizon
+
+
+def _attach_tpu_evidence(out: dict, tag: str,
+                         ev_path: str | None = None) -> None:
+    """On a run that could not measure the chip — cpu-fallback (wedged at
+    probe time) or wedged-mid-run (the BENCH_r02 failure mode) — attach the
+    standing healthy-window TPU capture (TPU_EVIDENCE.json, maintained by
+    scripts/tpu_watch.py and manual captures) to the JSON line.  The key
+    says "prior_capture": it is earlier evidence, not this run's
+    measurement, and captures older than 24 h are not attached at all (a
+    stale number must not masquerade as current-round evidence)."""
+    if tag not in ("(cpu-fallback)", "(wedged-mid-run)"):
+        return
+    if ev_path is None:
+        ev_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "TPU_EVIDENCE.json")
+    try:
+        with open(ev_path) as fh:
+            rec = json.load(fh)
+        import calendar
+        captured = calendar.timegm(time.strptime(
+            rec["captured_utc"], "%Y-%m-%dT%H:%M:%SZ"))
+        if time.time() - captured > _EVIDENCE_MAX_AGE_S:
+            return
+        out["tpu_evidence_prior_capture"] = rec
+    except (OSError, json.JSONDecodeError, KeyError, ValueError):
+        pass
+
+
 _DEADLINE_CHILDREN: list = []  # Popen handles to kill if the deadline fires
 
 
@@ -140,14 +170,18 @@ def _arm_run_deadline(workload: str, tag: str, epochs: int = 500,
                 p.kill()
             except Exception:
                 pass
-        line = json.dumps({
+        rec = {
             "metric": f"bench_{workload}(wedged-mid-run){tag}",
             "value": round(time.time() - t0, 1),
             "unit": f"s elapsed without finishing (deadline "
                     f"{deadline_min:.1f} min) — backend likely wedged "
                     "mid-measurement; no perf claim",
             "vs_baseline": 0,
-        })
+        }
+        # the mid-run wedge is the main case the prior-capture evidence
+        # exists for (BENCH_r02 lost the round's number exactly this way)
+        _attach_tpu_evidence(rec, "(wedged-mid-run)")
+        line = json.dumps(rec)
         (_emit or (lambda s: print(s, flush=True)))(line)
         print(f"bench: {workload} exceeded the {deadline_min:.1f} min "
               "deadline; aborting so the wedge is recorded instead of "
@@ -773,6 +807,7 @@ def main() -> int:
     if bgm != "sklearn":
         out["metric"] += f"({bgm}-bgm)"
     out["metric"] += tag
+    _attach_tpu_evidence(out, tag)
     print(json.dumps(out))
     return 0
 

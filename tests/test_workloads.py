@@ -150,3 +150,47 @@ def test_bench_setup_batch_size_raises_step_budget():
     # 600 rows over 2 iid clients -> 300 each: 300//150=2 vs 300//50=6
     assert list(t150.steps) == [2, 2]
     assert list(t50.steps) == [6, 6]
+
+
+def test_bench_attaches_tpu_evidence_on_fallback(tmp_path):
+    """Bench lines that could not measure the chip (cpu-fallback, wedged
+    mid-run) carry the standing healthy-window TPU capture under a key that
+    names it prior evidence; healthy and explicit-cpu runs don't, and stale
+    (>24 h) or unstamped captures are never attached."""
+    import importlib
+    import json as _json
+    import time as _time
+
+    bench = importlib.import_module("bench")
+    ev = tmp_path / "TPU_EVIDENCE.json"
+    fresh = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    ev.write_text(_json.dumps(
+        {"value": 0.8, "vs_baseline": 30.0, "captured_utc": fresh}))
+
+    for tag in ("(cpu-fallback)", "(wedged-mid-run)"):
+        out = {"metric": f"m{tag}"}
+        bench._attach_tpu_evidence(out, tag, ev_path=str(ev))
+        assert out["tpu_evidence_prior_capture"]["value"] == 0.8
+
+    for tag in ("", "(cpu)"):
+        clean = {"metric": "m"}
+        bench._attach_tpu_evidence(clean, tag, ev_path=str(ev))
+        assert "tpu_evidence_prior_capture" not in clean
+
+    stale = _time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() - 48 * 3600))
+    ev.write_text(_json.dumps(
+        {"value": 0.8, "vs_baseline": 30.0, "captured_utc": stale}))
+    out = {"metric": "m(cpu-fallback)"}
+    bench._attach_tpu_evidence(out, "(cpu-fallback)", ev_path=str(ev))
+    assert "tpu_evidence_prior_capture" not in out
+
+    ev.write_text(_json.dumps({"value": 0.8}))  # no timestamp -> no attach
+    out = {"metric": "m(cpu-fallback)"}
+    bench._attach_tpu_evidence(out, "(cpu-fallback)", ev_path=str(ev))
+    assert "tpu_evidence_prior_capture" not in out
+
+    missing = {"metric": "m(cpu-fallback)"}
+    bench._attach_tpu_evidence(
+        missing, "(cpu-fallback)", ev_path=str(tmp_path / "absent.json"))
+    assert "tpu_evidence_prior_capture" not in missing
