@@ -153,3 +153,22 @@ def test_multihost_participant_checkpoint_roundtrip(tmp_path):
     bad_seed = MultihostRun(epochs=4, seed=9, ckpt_dir=str(tmp_path))
     with pytest.raises(RuntimeError, match="seed"):
         _load_participant(bad_seed, 1, n_clients=2, cfg=cfg)
+
+
+def test_synthesizer_artifact_bakes_debiased_ema(fed_init, tmp_path):
+    """An EMA trainer's saved sampling artifact carries the bias-corrected
+    EMA generator: the loaded synthesizer reproduces the trainer's (EMA)
+    samples, which differ from the raw post-aggregation model's."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, ema_decay=0.9)
+    tr = FederatedTrainer(fed_init, config=cfg, mesh=client_mesh(4), seed=0)
+    tr.fit(epochs=2)
+    save_synthesizer(tr, str(tmp_path / "e"))
+    loaded = load_synthesizer(str(tmp_path / "e"))
+    np.testing.assert_allclose(
+        tr.sample_encoded(80, seed=2), loaded.sample_encoded(80, seed=2),
+        atol=1e-5,
+    )
+    raw = tr.sample_encoded(80, seed=2, use_ema=False)
+    assert not np.allclose(tr.sample_encoded(80, seed=2), raw, atol=1e-5)
