@@ -340,7 +340,8 @@ def _val_synth_f1(synth, val, reference_frame, target, categorical) -> float:
 def bench_utility(epochs: int = 500, n_clients: int = 2,
                   weighted: bool = True, bgm_backend: str = "sklearn",
                   select: str = "none", train_rows: int | None = None,
-                  batch_size: int = 500, ema_decay: float = 0.0) -> dict:
+                  batch_size: int = 500, ema_decay: float = 0.0,
+                  gan_seed: int = 0) -> dict:
     """Driver-reproducible ΔF1: the reference utility_analysis protocol
     (reference Server/utility_analysis.py:94-119, README.md:67 headline
     0.0850 at 500 epochs on the FULL training CSV).
@@ -388,6 +389,7 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     _, init, trainer = _setup(
         n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend,
         df=gan_df, batch_size=batch_size, ema_decay=ema_decay,
+        seed=gan_seed,
     )
     cols = init.global_meta.column_names
     real_train = train_df[cols]
@@ -494,6 +496,8 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         suffix += f"(batch={batch_size})"
     if ema_decay > 0:
         suffix += f"(ema={ema_decay})"
+    if gan_seed != 0:
+        suffix += f"(seed={gan_seed})"
     return {
         "metric": f"intrusion_{n_clients}client_delta_f1_at_{epochs}{suffix}",
         "value": round(float(u["delta_f1"]), 4),
@@ -719,6 +723,11 @@ def main() -> int:
                          "client, so smaller batches raise the step budget "
                          "at a fixed epoch horizon — the small-sample "
                          "lever for the surviving 7k-row table)")
+    ap.add_argument("--gan-seed", type=int, default=0,
+                    help="utility workload: GAN training seed (sharding + "
+                         "init + noise); classifier protocol stays seed 69 "
+                         "like the reference — vary this to measure the "
+                         "per-trajectory ΔF1 spread")
     ap.add_argument("--ema-decay", type=float, default=0.0,
                     help="utility workload: per-round EMA of the aggregated "
                          "generator; sampling/eval use the smoothed model "
@@ -791,7 +800,7 @@ def main() -> int:
             epochs, n_clients=clients, weighted=not args.uniform,
             bgm_backend=bgm, select=args.select,
             train_rows=args.train_rows, batch_size=args.batch_size,
-            ema_decay=args.ema_decay,
+            ema_decay=args.ema_decay, gan_seed=args.gan_seed,
         )
     elif args.workload == "multihost":
         out = bench_multihost(epochs)
