@@ -92,9 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--embedding-dim", type=int, default=128)
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="per-round EMA of the aggregated generator "
-                        "(fedavg single-program mode only); snapshots, "
-                        "monitor and saved models use the smoothed "
-                        "generator.  0 = off (reference protocol)")
+                        "(fedavg mode, single-program or multi-process); "
+                        "snapshots, monitor and saved models use the "
+                        "smoothed generator.  0 = off (reference protocol)")
     p.add_argument("--sample-rows", type=int, default=40000)
     p.add_argument("--monitor-every", type=int, default=0,
                    help="rounds between on-device Avg_JSD/Avg_WD probes "
@@ -308,7 +308,9 @@ def _run_multihost_init(args) -> int:
 
                 join_mesh(args.rank)
                 cfg = TrainConfig(
-                    batch_size=args.batch_size, embedding_dim=args.embedding_dim
+                    batch_size=args.batch_size,
+                    embedding_dim=args.embedding_dim,
+                    ema_decay=args.ema_decay,
                 )
                 client_train(t, out, cfg, make_run())
                 print(f"rank {args.rank} training complete")
@@ -439,11 +441,10 @@ def main(argv=None) -> int:
                      "ctgan.py:28-30)")
     if not 0.0 <= args.ema_decay < 1.0:
         parser.error(f"--ema-decay {args.ema_decay}: must be in [0, 1)")
-    if args.ema_decay > 0 and (
-            args.mode != "fedavg" or (args.rank is not None and args.ip)):
-        parser.error("--ema-decay is only supported in the single-program "
-                     "fedavg mode (not mdgan/standalone or the "
-                     "multi-process launch)")
+    if args.ema_decay > 0 and args.mode != "fedavg":
+        parser.error("--ema-decay is only supported in fedavg mode "
+                     "(single-program or multi-process), not "
+                     "mdgan/standalone")
 
     if args.decode:
         # the trainers read the selection at construction time via

@@ -91,7 +91,8 @@ def _ckpt_path(run: MultihostRun, rank: int) -> str:
 
 
 def _save_participant(run: MultihostRun, rank: int, models_g, chain,
-                      epochs_done: int, n_clients: int, cfg) -> None:
+                      epochs_done: int, n_clients: int, cfg,
+                      ema=None) -> None:
     """Persist this rank's view of the training state, atomically.
 
     Post-psum model state is replicated, so each rank's shard IS the
@@ -113,6 +114,15 @@ def _save_participant(run: MultihostRun, rank: int, models_g, chain,
         "models": local_shard(models_g),
         "chain": np.asarray(kd.addressable_shards[0].data),
     }
+    if ema is not None:
+        # raw (biased) EMA chain — replicated leaves, so no axis squeeze;
+        # ema_updates == epochs_done (EMA runs from round 0)
+        state["ema"] = jax.tree.map(
+            lambda leaf: np.asarray(
+                leaf.addressable_shards[0].data
+                if hasattr(leaf, "addressable_shards") else leaf),
+            ema,
+        )
     os.makedirs(run.ckpt_dir, exist_ok=True)
     path = _ckpt_path(run, rank)
     tmp = path + ".tmp"
@@ -176,12 +186,7 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     Returns the final aggregated model params (host pytrees) after sending
     them to rank 0 for the cross-host equality check.
     """
-    if getattr(cfg, "ema_decay", 0.0) > 0.0:
-        raise NotImplementedError(
-            "generator EMA (cfg.ema_decay > 0) is a single-program "
-            "FederatedTrainer feature; the multi-process trainer does not "
-            "carry the EMA state"
-        )
+    use_ema = getattr(cfg, "ema_decay", 0.0) > 0.0
     spec = SegmentSpec.from_output_info(init_out["transformer"].output_info)
     mesh = participant_mesh()
     n_clients = int(mesh.devices.size)
@@ -266,13 +271,50 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                 "round 0 (each rank keeps only its latest checkpoint in "
                 f"{run.ckpt_dir})"
             )
+    # EMA carry (cfg.ema_decay > 0): replicated like the key chain, same
+    # zero-seed + read-time debias contract as FederatedTrainer.  Passed
+    # uncommitted on the first chunk; subsequent chunks feed back the
+    # replicated output.  ema_updates == rounds completed (EMA runs from
+    # round 0), so e tracks it.
+    ema_g = None
     if saved is not None:
         chain = jax.random.wrap_key_data(np.asarray(saved["chain"]))
         models_g = from_local_chunk(mesh, add_axis(saved["models"]))
+        if use_ema:
+            if "ema" not in saved:
+                raise RuntimeError(
+                    f"resume with ema_decay={cfg.ema_decay} but the rank "
+                    f"{transport.rank} checkpoint carries no EMA chain "
+                    "(saved by an EMA-off or pre-EMA run?)"
+                )
+            ema_g = jax.tree.map(np.asarray, saved["ema"])
     else:
         e_start = 0
         one = init_models(init_key, spec, cfg)
         models_g = from_local_chunk(mesh, add_axis(one))
+        if use_ema:
+            ema_g = jax.tree.map(
+                lambda x: np.zeros_like(np.asarray(x)),
+                (one.params_g, one.state_g),
+            )
+
+    def ema_sampling_model(t: int, on_device: bool):
+        """Debiased EMA (params_g, state_g) after ``t`` rounds.  The EMA
+        output is replicated (P()), so the addressable shard IS the full
+        value — no clients-axis squeeze, unlike local_shard.  Leaves are
+        host numpy (not yet device arrays) when no chunk has run this
+        launch — an already-complete resume reaches the done message with
+        the checkpointed EMA untouched."""
+        scale = 1.0 / (1.0 - cfg.ema_decay ** t)
+
+        def get(leaf):
+            data = (leaf.addressable_shards[0].data
+                    if hasattr(leaf, "addressable_shards") else leaf)
+            if not on_device:
+                data = np.asarray(data)
+            return data * scale
+
+        return (jax.tree.map(get, ema_g[0]), jax.tree.map(get, ema_g[1]))
 
     # generation uses the POOLED empirical frequencies from the init
     # protocol (the reference server's full-table Cond, distributed.py:565-580)
@@ -321,9 +363,16 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                     spec, cfg, max_steps, mesh, k=1, rounds=size
                 )
             t0 = time.time()
-            models_g, metrics, chain, _finite = epoch_fns[size](
-                models_g, data_g, cond_g, rows_g, steps_g, weights_g, chain
-            )
+            if use_ema:
+                models_g, metrics, chain, _finite, ema_g = epoch_fns[size](
+                    models_g, data_g, cond_g, rows_g, steps_g, weights_g,
+                    chain, ema_g,
+                )
+            else:
+                models_g, metrics, chain, _finite = epoch_fns[size](
+                    models_g, data_g, cond_g, rows_g, steps_g, weights_g,
+                    chain,
+                )
             last = e + size - 1
             finish = None
             snap_due = sender is not None and last in firing
@@ -337,10 +386,15 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                 # with the chunk still executing on device, so it stays
                 # inside the chunk's reported wall-clock.
                 sender.throttle()  # bound live result buffers FIRST
+                if use_ema:
+                    # snapshots sample the debiased EMA generator, same
+                    # coherence contract as FederatedTrainer._global_model
+                    pg_s, sg_s = ema_sampling_model(last + 1, on_device=True)
+                else:
+                    pg_s = local_shard_device(models_g.params_g)
+                    sg_s = local_shard_device(models_g.state_g)
                 finish = sampler.sample_async(
-                    local_shard_device(models_g.params_g),
-                    local_shard_device(models_g.state_g),
-                    pooled_cond, run.sample_rows,
+                    pg_s, sg_s, pooled_cond, run.sample_rows,
                     jax.random.key(run.seed + last + 29),
                 )
             jax.block_until_ready(models_g)
@@ -359,10 +413,14 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                     # oversized request: the memory-bounded synchronous
                     # sample, after the sync (it blocks on transfers anyway)
                     sender.throttle()  # bound live result buffers FIRST
+                    if use_ema:
+                        pg_s, sg_s = ema_sampling_model(
+                            last + 1, on_device=False)
+                    else:
+                        pg_s = local_shard(models_g.params_g)
+                        sg_s = local_shard(models_g.state_g)
                     parts = sampler.sample(
-                        local_shard(models_g.params_g),
-                        local_shard(models_g.state_g),
-                        pooled_cond, run.sample_rows,
+                        pg_s, sg_s, pooled_cond, run.sample_rows,
                         jax.random.key(run.seed + last + 29),
                     )
                     finish = lambda parts=parts: parts  # noqa: E731
@@ -374,7 +432,8 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
             if save_due(last):
                 _save_participant(run, transport.rank, models_g, chain,
                                   epochs_done=last + 1,
-                                  n_clients=n_clients, cfg=cfg)
+                                  n_clients=n_clients, cfg=cfg,
+                                  ema=ema_g)
             if run.log_every and (last % run.log_every == 0 or last == end - 1):
                 m = {k: float(np.asarray(v.addressable_shards[0].data).mean())
                      for k, v in metrics.items()}
@@ -386,11 +445,18 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
             e += size
 
         final_params = local_shard(models_g.params_g)
+        done_msg = {"type": "done", "params_g": final_params}
+        if use_ema and e > 0:
+            # debiased sampling model, for the server's cross-host equality
+            # check and downstream consumers (tests compare it against the
+            # single-program trainer's _global_model())
+            done_msg["ema"] = ema_sampling_model(e, on_device=False)
         if sender is not None:
-            sender.send({"type": "done", "params_g": final_params})
+            sender.send(dict(done_msg))
     if sender is None:
-        transport.send_obj({"type": "done", "params_g": final_params})
-    return {"params_g": final_params, "models": models_g}
+        transport.send_obj(done_msg)
+    return {"params_g": final_params, "models": models_g,
+            "ema": done_msg.get("ema")}
 
 
 def server_train(
@@ -440,7 +506,7 @@ def server_train(
         while True:
             msg = transport.recv_obj(1)
             if msg["type"] == "done":
-                finals = [msg["params_g"]]
+                finals = [(msg["params_g"], msg.get("ema"))]
                 break
             if "decode_tables" in msg:
                 assemble = make_assemble_packed_q(msg["decode_tables"])
@@ -459,17 +525,23 @@ def server_train(
                 print(f"[server] round {msg['last']}: {per_round:.3f}s/round")
 
     finals += [
-        transport.recv_obj(rank)["params_g"]
+        (lambda m: (m["params_g"], m.get("ema")))(transport.recv_obj(rank))
         for rank in range(2, transport.n_clients + 1)
     ]
+    # the check covers the EMA chain too when enabled (None collapses to an
+    # empty subtree); a leaf-count mismatch means ranks disagree on whether
+    # EMA is on — also a broken invariant
     base_leaves = jax.tree.leaves(finals[0])
     for r, tree in enumerate(finals[1:], start=2):
-        for a, b in zip(base_leaves, jax.tree.leaves(tree)):
-            if not np.array_equal(np.asarray(a), np.asarray(b)):
-                raise RuntimeError(
-                    f"post-psum params differ between rank 1 and rank {r}: "
-                    "the cross-host FedAvg collective is broken"
-                )
+        leaves = jax.tree.leaves(tree)
+        if len(leaves) != len(base_leaves) or any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(base_leaves, leaves)
+        ):
+            raise RuntimeError(
+                f"post-psum params differ between rank 1 and rank {r}: "
+                "the cross-host FedAvg collective is broken"
+            )
     if not quiet:
         print(
             f"final aggregated params identical across {len(finals)} hosts "

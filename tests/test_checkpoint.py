@@ -137,6 +137,17 @@ def test_multihost_participant_checkpoint_roundtrip(tmp_path):
     restored = jax.random.wrap_key_data(np.asarray(st["chain"]))
     assert jax.random.uniform(restored) == jax.random.uniform(chain)
     assert not list(tmp_path.glob("*.tmp"))  # atomic rename left no temp
+    assert "ema" not in st  # EMA-off saves carry no EMA field
+
+    # EMA chain rides along when provided: replicated leaves (no clients
+    # axis), accepted as device arrays or host numpy
+    ema = ({"g": np.full((3,), 2.0, np.float32)},
+           {"bn": np.full((2,), 5.0, np.float32)})
+    _save_participant(run, 1, models_g, chain, epochs_done=2,
+                      n_clients=2, cfg=cfg, ema=ema)
+    st2 = _load_participant(run, 1, n_clients=2, cfg=cfg)
+    np.testing.assert_array_equal(st2["ema"][0]["g"], ema[0]["g"])
+    np.testing.assert_array_equal(st2["ema"][1]["bn"], ema[1]["bn"])
 
     # validation: every mismatch names the offending fields
     import shutil

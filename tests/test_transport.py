@@ -434,3 +434,93 @@ print(f"rank {{rank}} ok")
     combined = "\n".join(outs)
     assert any(p.returncode != 0 for p in procs), combined[-2000:]
     assert "disagree on the resume round" in combined, combined[-3000:]
+
+
+@pytest.mark.slow
+def test_multihost_ema_matches_in_process(tmp_path):
+    """With cfg.ema_decay > 0, the multi-process trainer carries the same
+    replicated EMA chain as the single-program FederatedTrainer: the
+    debiased EMA shipped in the done message equals the in-process
+    trainer's _global_model() bit for bit, and the EMA-off raw params stay
+    bit-identical too (the carry must not perturb training)."""
+    import pickle
+    import subprocess
+    import sys
+
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    shards, paths = _toy_shards(tmp_path)
+    port = 25000 + os.getpid() % 2000
+
+    driver = tmp_path / "mh_ema_driver.py"
+    driver.write_text(f"""
+import pickle, sys
+rank = int(sys.argv[1])
+from fed_tgan_tpu.parallel.multihost import initialize_multihost
+initialize_multihost("127.0.0.1", {port}, 3, rank, backend="cpu", n_local_devices=1)
+from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
+from fed_tgan_tpu.train.multihost import MultihostRun, client_train, server_train
+run = MultihostRun(epochs=3, sample_every=0, sample_rows=32, seed=0)
+if rank == 0:
+    with ServerTransport({port}, 2, timeout_ms=120_000) as t:
+        from fed_tgan_tpu.federation.distributed import server_initialize
+        out = server_initialize(t, seed=0)
+        server_train(t, out, run, "toy", out_dir=r"{tmp_path}", quiet=True)
+else:
+    import pandas as pd
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.federation.distributed import client_initialize
+    pre = TablePreprocessor(
+        frame=pd.read_csv(sys.argv[2]), name="toy",
+        categorical_columns=["color", "flag"], target_column="flag",
+        problem_type="binary_classification",
+    )
+    with ClientTransport("127.0.0.1", {port}, rank, timeout_ms=120_000) as t:
+        out = client_initialize(t, pre, seed=0)
+        from fed_tgan_tpu.train.steps import TrainConfig
+        cfg = TrainConfig(batch_size=40, embedding_dim=16, ema_decay=0.9)
+        res = client_train(t, out, cfg, run)
+    with open(r"{tmp_path}" + f"/ema_rank{{rank}}.pkl", "wb") as f:
+        pickle.dump({{"params_g": res["params_g"], "ema": res["ema"]}}, f)
+print(f"rank {{rank}} ok")
+""")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(driver), str(r)] + ([paths[r - 1]] if r else []),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for r in (0, 1, 2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+
+    clients = [
+        TablePreprocessor(
+            frame=s, name="toy", categorical_columns=["color", "flag"],
+            target_column="flag", problem_type="binary_classification",
+        )
+        for s in shards
+    ]
+    init = federated_initialize(clients, seed=0)
+    cfg = TrainConfig(batch_size=40, embedding_dim=16, ema_decay=0.9)
+    trainer = FederatedTrainer(init, config=cfg, seed=0)
+    trainer.fit(3)
+    import jax
+
+    want_ema = jax.tree.map(np.asarray, trainer._global_model())
+    want_raw = jax.tree.map(lambda x: np.asarray(x)[0], trainer.models.params_g)
+
+    with open(tmp_path / "ema_rank1.pkl", "rb") as f:
+        got = pickle.load(f)
+    for a, b in zip(jax.tree.leaves(want_raw), jax.tree.leaves(got["params_g"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(want_ema), jax.tree.leaves(got["ema"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
