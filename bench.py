@@ -195,7 +195,8 @@ def _arm_run_deadline(workload: str, tag: str, epochs: int = 500,
 
 def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
            bgm_backend: str = "sklearn", df=None, batch_size: int = 500,
-           ema_decay: float = 0.0):
+           ema_decay: float = 0.0, lr_schedule: str = "constant",
+           lr_decay_steps: int = 0):
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -218,7 +219,9 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
         clients, seed=seed, weighted=weighted, backend=bgm_backend
     )
     trainer = FederatedTrainer(
-        init, config=TrainConfig(batch_size=batch_size, ema_decay=ema_decay),
+        init, config=TrainConfig(batch_size=batch_size, ema_decay=ema_decay,
+                                 lr_schedule=lr_schedule,
+                                 lr_decay_steps=lr_decay_steps),
         seed=seed,
     )
     return df, init, trainer
@@ -341,7 +344,7 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
                   weighted: bool = True, bgm_backend: str = "sklearn",
                   select: str = "none", train_rows: int | None = None,
                   batch_size: int = 500, ema_decay: float = 0.0,
-                  gan_seed: int = 0) -> dict:
+                  gan_seed: int = 0, lr_schedule: str = "constant") -> dict:
     """Driver-reproducible ΔF1: the reference utility_analysis protocol
     (reference Server/utility_analysis.py:94-119, README.md:67 headline
     0.0850 at 500 epochs on the FULL training CSV).
@@ -386,10 +389,17 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     # fit on the full train split, scored on the untouched holdout), so
     # the curve isolates generator quality vs its training-data size
     gan_df = train_df if train_rows is None else train_df.iloc[:train_rows]
+    # the decay spans the whole run: the LARGEST client's optimizer steps
+    # at the final epoch (same formula as cli._lr_decay_steps — iid shard
+    # sizes are ceil/floor(rows/n_clients), and sizing to the floor would
+    # let the bigger shard exhaust the schedule before the run ends)
+    max_shard = -(-len(gan_df) // n_clients)
+    decay_steps = epochs * max(1, max_shard // batch_size)
     _, init, trainer = _setup(
         n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend,
         df=gan_df, batch_size=batch_size, ema_decay=ema_decay,
-        seed=gan_seed,
+        seed=gan_seed, lr_schedule=lr_schedule,
+        lr_decay_steps=decay_steps if lr_schedule != "constant" else 0,
     )
     cols = init.global_meta.column_names
     real_train = train_df[cols]
@@ -498,6 +508,8 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         suffix += f"(ema={ema_decay})"
     if gan_seed != 0:
         suffix += f"(seed={gan_seed})"
+    if lr_schedule != "constant":
+        suffix += f"(lr={lr_schedule})"
     return {
         "metric": f"intrusion_{n_clients}client_delta_f1_at_{epochs}{suffix}",
         "value": round(float(u["delta_f1"]), 4),
@@ -723,6 +735,11 @@ def main() -> int:
                          "client, so smaller batches raise the step budget "
                          "at a fixed epoch horizon — the small-sample "
                          "lever for the surviving 7k-row table)")
+    ap.add_argument("--lr-schedule", choices=["constant", "cosine", "linear"],
+                    default="constant",
+                    help="utility workload: G+D learning-rate decay over "
+                         "the full run (constant = the reference's fixed "
+                         "2e-4)")
     ap.add_argument("--gan-seed", type=int, default=0,
                     help="utility workload: GAN training seed (sharding + "
                          "init + noise); classifier protocol stays seed 69 "
@@ -801,6 +818,7 @@ def main() -> int:
             bgm_backend=bgm, select=args.select,
             train_rows=args.train_rows, batch_size=args.batch_size,
             ema_decay=args.ema_decay, gan_seed=args.gan_seed,
+            lr_schedule=args.lr_schedule,
         )
     elif args.workload == "multihost":
         out = bench_multihost(epochs)

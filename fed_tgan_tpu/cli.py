@@ -90,6 +90,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-virtual-devices", type=int, default=8)
     p.add_argument("--batch-size", type=int, default=500)
     p.add_argument("--embedding-dim", type=int, default=128)
+    p.add_argument("--lr-schedule",
+                   choices=["constant", "cosine", "linear"],
+                   default="constant",
+                   help="G+D learning-rate decay spanning the full -epochs "
+                        "horizon (constant = the reference's fixed 2e-4)")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="per-round EMA of the aggregated generator "
                         "(fedavg mode, single-program or multi-process); "
@@ -311,10 +316,24 @@ def _run_multihost_init(args) -> int:
                     batch_size=args.batch_size,
                     embedding_dim=args.embedding_dim,
                     ema_decay=args.ema_decay,
+                    # rows_per_client comes from the init protocol, so
+                    # every rank derives the SAME decay horizon
+                    lr_schedule=args.lr_schedule,
+                    lr_decay_steps=_lr_decay_steps(
+                        args, max(int(r) for r in out["rows_per_client"])),
                 )
                 client_train(t, out, cfg, make_run())
                 print(f"rank {args.rank} training complete")
     return 0
+
+
+def _lr_decay_steps(args, max_shard_rows: int) -> int:
+    """Decay horizon in optimizer steps: the largest client's step count at
+    the final epoch (smaller shards advance the schedule slower — counts
+    only grow on real steps).  0 when the schedule is constant."""
+    if args.lr_schedule == "constant":
+        return 0
+    return args.epochs * max(1, max_shard_rows // args.batch_size)
 
 
 def _eval_categorical_columns(kwargs) -> list:
@@ -557,7 +576,10 @@ def main(argv=None) -> int:
     columns = list(selected) if selected else list(frames[0].columns)
     cfg = TrainConfig(batch_size=args.batch_size,
                       embedding_dim=args.embedding_dim,
-                      ema_decay=args.ema_decay)
+                      ema_decay=args.ema_decay,
+                      lr_schedule=args.lr_schedule,
+                      lr_decay_steps=_lr_decay_steps(
+                          args, max(len(f) for f in frames)))
     if args.mode == "standalone":
         # no participants, no harmonization/refit protocol — skip the
         # federated construction entirely

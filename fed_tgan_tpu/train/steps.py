@@ -55,6 +55,15 @@ class TrainConfig:
     # a small-sample smoothing lever for the 500-epoch ΔF1 horizon, where
     # per-round snapshot noise exceeds between-round signal (PARITY.md).
     ema_decay: float = 0.0
+    # Learning-rate schedule over OPTIMIZER STEPS (Adam count), applied to
+    # both G and D.  "constant" = the reference's fixed 2e-4 (bit-identical
+    # chain to pre-schedule builds).  "cosine"/"linear" decay from cfg.lr
+    # to lr*lr_end_frac over lr_decay_steps counts; clients whose shards
+    # give them fewer steps per epoch simply advance the schedule slower
+    # (counts only increment on real, unmasked steps).
+    lr_schedule: str = "constant"
+    lr_decay_steps: int = 0
+    lr_end_frac: float = 0.0
 
 
 class ModelBundle(NamedTuple):
@@ -71,15 +80,40 @@ def make_optimizers(cfg: TrainConfig):
     """torch-Adam-equivalent optax chains.
 
     torch's Adam ``weight_decay`` adds wd*p to the gradient *before* the
-    moment updates, so the decay transform precedes scale_by_adam."""
+    moment updates, so the decay transform precedes scale_by_adam.  With
+    ``cfg.lr_schedule != "constant"`` the fixed scale becomes a per-count
+    schedule; the constant case keeps the exact pre-schedule chain (same
+    opt-state structure, bit-identical trajectory)."""
+    if cfg.lr_schedule == "constant":
+        lr_term = lambda: optax.scale(-cfg.lr)
+    else:
+        if cfg.lr_decay_steps <= 0:
+            raise ValueError(
+                f"lr_schedule={cfg.lr_schedule!r} needs lr_decay_steps > 0 "
+                "(total optimizer steps the decay spans)"
+            )
+        if cfg.lr_schedule == "cosine":
+            sched = optax.cosine_decay_schedule(
+                cfg.lr, cfg.lr_decay_steps, alpha=cfg.lr_end_frac
+            )
+        elif cfg.lr_schedule == "linear":
+            sched = optax.linear_schedule(
+                cfg.lr, cfg.lr * cfg.lr_end_frac, cfg.lr_decay_steps
+            )
+        else:
+            raise ValueError(
+                f"unknown lr_schedule {cfg.lr_schedule!r} "
+                "(constant | cosine | linear)"
+            )
+        lr_term = lambda: optax.scale_by_learning_rate(sched)
     opt_g = optax.chain(
         optax.add_decayed_weights(cfg.l2scale),
         optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2),
-        optax.scale(-cfg.lr),
+        lr_term(),
     )
     opt_d = optax.chain(
         optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2),
-        optax.scale(-cfg.lr),
+        lr_term(),
     )
     return opt_g, opt_d
 
