@@ -143,13 +143,13 @@ def test_bench_setup_batch_size_raises_step_budget():
     import pandas as pd
 
     bench = importlib.import_module("bench")
-    df = pd.read_csv(bench.CSV_PATH).head(600)
-    _, init, t150 = bench._setup(df=df, batch_size=150)
+    df = pd.read_csv(bench.CSV_PATH).head(400)
+    _, init, t100 = bench._setup(df=df, batch_size=100)
     t50 = FederatedTrainer(init, config=TrainConfig(batch_size=50), seed=0)
-    assert t150.cfg.batch_size == 150 and t50.cfg.batch_size == 50
-    # 600 rows over 2 iid clients -> 300 each: 300//150=2 vs 300//50=6
-    assert list(t150.steps) == [2, 2]
-    assert list(t50.steps) == [6, 6]
+    assert t100.cfg.batch_size == 100 and t50.cfg.batch_size == 50
+    # 400 rows over 2 iid clients -> 200 each: 200//100=2 vs 200//50=4
+    assert list(t100.steps) == [2, 2]
+    assert list(t50.steps) == [4, 4]
 
 
 def test_bench_attaches_tpu_evidence_on_fallback(tmp_path):
