@@ -342,3 +342,33 @@ class TestLRSchedule:
         tr = FederatedTrainer(fed_init, config=cfg, mesh=client_mesh(4), seed=0)
         tr.fit(epochs=2)
         assert tr.sample(60, seed=1).shape == (60, 4)
+
+    def test_uneven_shards_advance_schedule_independently(self, toy_frame, toy_spec):
+        """Schedule counts only grow on real (unmasked) steps: with uneven
+        shards the bigger client advances its decay further per epoch, and
+        the post-psum params still agree across clients."""
+        import dataclasses
+
+        # dirichlet label skew gives genuinely unequal shard sizes
+        # (320/280 rows at this seed -> 5 vs 4 steps per epoch at batch 60)
+        shards = shard_dataframe(toy_frame, 2, "dirichlet",
+                                 label_column="flag", alpha=0.8, seed=2)
+        clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+        init = federated_initialize(clients, seed=0)
+        cfg = dataclasses.replace(CFG, batch_size=60, lr_schedule="cosine",
+                                  lr_decay_steps=10)
+        tr = FederatedTrainer(init, config=cfg, mesh=client_mesh(2), seed=0)
+        assert tr.steps[0] != tr.steps[1]  # the premise: uneven step budgets
+        tr.fit(epochs=2)
+        # schedule count lives in the optimizer state; per-client counts
+        # must equal 2 * steps_i exactly (masked steps rolled back)
+        counts = [
+            np.asarray(leaf)
+            for leaf in jax.tree.leaves(tr.models.opt_d)
+            if np.asarray(leaf).ndim == 1 and np.asarray(leaf).dtype == np.int32
+        ]
+        assert counts, "no schedule count leaf found in opt state"
+        per_client = counts[-1]
+        np.testing.assert_array_equal(per_client, 2 * tr.steps)
+        pg = np.asarray(jax.tree.leaves(tr.models.params_g)[0])
+        assert np.allclose(pg[0], pg[1], atol=1e-6)
