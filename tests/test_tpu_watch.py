@@ -86,3 +86,71 @@ def test_special_workloads_map_to_bench_args(watch, monkeypatch):
     # plain workloads pass through; round means no --workload flag
     watch.run_workload("round", "BENCH_rX")
     assert "--workload" not in fake.last_cmd
+
+
+def test_good_capture_removes_stale_failed_evidence(watch, tmp_path, monkeypatch):
+    # a wedge leaves .failed.json; a later good capture must not leave the
+    # outdated failure evidence beside the fresh number
+    (tmp_path / "BENCH_rX_round.failed.json").write_text("{}\n")
+    line = json.dumps({"metric": "intrusion_round", "value": 0.7,
+                       "unit": "s/round", "vs_baseline": 34.0})
+    monkeypatch.setattr(watch.subprocess, "run", _fake_run(line))
+    assert watch.run_workload("round", "BENCH_rX") is True
+    assert not (tmp_path / "BENCH_rX_round.failed.json").exists()
+    assert (tmp_path / "BENCH_rX_round.json").exists()
+
+
+def test_full500s_maps_to_sparse_snapshot_run(watch, monkeypatch):
+    line = json.dumps({"metric": "m", "value": 1.0})
+    fake = _fake_run(line)
+    monkeypatch.setattr(watch.subprocess, "run", fake)
+    watch.run_workload("full500s", "BENCH_rX")
+    cmd = fake.last_cmd
+    assert "--workload" in cmd and "full500" in cmd
+    assert "--sample-every" in cmd and "25" in cmd
+
+
+def test_main_loop_tracks_completion_in_memory(watch, tmp_path, monkeypatch):
+    # a stale <prefix>_<wl>.json from a previous watcher run must NOT count
+    # as this run's capture: the loop re-measures every requested workload,
+    # and the pre-existing evidence is archived to .stale at launch so it
+    # can't be misread as this run's output
+    (tmp_path / "BENCH_rX_round.json").write_text(
+        json.dumps({"metric": "intrusion_round", "value": 9.9}) + "\n")
+    (tmp_path / "BENCH_rX_scale.failed.json").write_text("{}\n")
+    ran = []
+    monkeypatch.setattr(watch, "probe_once", lambda timeout_s: True)
+    monkeypatch.setattr(
+        watch, "run_workload", lambda wl, prefix: (ran.append(wl), True)[1])
+    monkeypatch.setattr(watch.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        watch.sys, "argv",
+        ["tpu_watch.py", "--workloads", "round,scale",
+         "--out-prefix", "BENCH_rX"])
+    assert watch.main() == 0
+    assert ran == ["round", "scale"]
+    assert not (tmp_path / "BENCH_rX_round.json").exists()
+    assert (tmp_path / "BENCH_rX_round.json.stale").exists()
+    assert not (tmp_path / "BENCH_rX_scale.failed.json").exists()
+    assert (tmp_path / "BENCH_rX_scale.failed.json.stale").exists()
+
+
+def test_main_loop_retries_failed_workload_next_cycle(watch, monkeypatch):
+    calls = []
+
+    def fake_run_workload(wl, prefix):
+        calls.append(wl)
+        # scale fails the first time it is attempted, succeeds on retry
+        return not (wl == "scale" and calls.count("scale") == 1)
+
+    monkeypatch.setattr(watch, "probe_once", lambda timeout_s: True)
+    monkeypatch.setattr(watch, "run_workload", fake_run_workload)
+    monkeypatch.setattr(watch.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        watch.sys, "argv",
+        ["tpu_watch.py", "--workloads", "round,scale,full500s",
+         "--out-prefix", "BENCH_rX"])
+    assert watch.main() == 0
+    # round captured once, scale retried after the failed cycle, full500s
+    # runs only after scale clears — order preserved across cycles
+    assert calls == ["round", "scale", "scale", "full500s"]
