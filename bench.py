@@ -202,7 +202,8 @@ def _arm_run_deadline(workload: str, tag: str, epochs: int = 500,
 def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
            bgm_backend: str = "sklearn", df=None, batch_size: int = 500,
            ema_decay: float = 0.0, lr_schedule: str = "constant",
-           lr_decay_steps: int = 0):
+           lr_decay_epochs: int = 0, shard_strategy: str = "iid",
+           alpha: float = 0.5):
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -216,7 +217,18 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
         df = pd.read_csv(CSV_PATH)
     kwargs = preprocessor_kwargs(INTRUSION)
     selected = kwargs.pop("selected_columns")
-    frames = shard_dataframe(df, n_clients, "iid", seed=seed)
+    label_col = ("class" if shard_strategy in ("label_sorted", "dirichlet")
+                 else None)
+    frames = shard_dataframe(df, n_clients, shard_strategy,
+                             label_column=label_col, alpha=alpha, seed=seed)
+    # the decay spans the whole run: sized to the LARGEST client's actual
+    # optimizer-step count (same intent as cli._lr_decay_steps) — computed
+    # HERE, from the real shard sizes, because non-IID strategies make the
+    # biggest shard much larger than ceil(rows/n_clients)
+    lr_decay_steps = 0
+    if lr_schedule != "constant" and lr_decay_epochs:
+        max_shard = max(len(f) for f in frames)
+        lr_decay_steps = lr_decay_epochs * max(1, max_shard // batch_size)
     clients = [
         TablePreprocessor(frame=f, name="Intrusion", selected_columns=selected, **kwargs)
         for f in frames
@@ -227,7 +239,13 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
     trainer = FederatedTrainer(
         init, config=TrainConfig(batch_size=batch_size, ema_decay=ema_decay,
                                  lr_schedule=lr_schedule,
-                                 lr_decay_steps=lr_decay_steps),
+                                 lr_decay_steps=lr_decay_steps,
+                                 # skewed splits can leave a client under
+                                 # one batch; the reference lets it ride
+                                 # with 0 local steps, and the non-IID
+                                 # comparison must keep that semantic
+                                 allow_zero_step_clients=(
+                                     shard_strategy != "iid")),
         seed=seed,
     )
     return df, init, trainer
@@ -378,7 +396,8 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
                   weighted: bool = True, bgm_backend: str = "sklearn",
                   select: str = "none", train_rows: int | None = None,
                   batch_size: int = 500, ema_decay: float = 0.0,
-                  gan_seed: int = 0, lr_schedule: str = "constant") -> dict:
+                  gan_seed: int = 0, lr_schedule: str = "constant",
+                  shard_strategy: str = "iid", alpha: float = 0.5) -> dict:
     """Driver-reproducible ΔF1: the reference utility_analysis protocol
     (reference Server/utility_analysis.py:94-119, README.md:67 headline
     0.0850 at 500 epochs on the FULL training CSV).
@@ -423,17 +442,11 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     # fit on the full train split, scored on the untouched holdout), so
     # the curve isolates generator quality vs its training-data size
     gan_df = train_df if train_rows is None else train_df.iloc[:train_rows]
-    # the decay spans the whole run: the LARGEST client's optimizer steps
-    # at the final epoch (same formula as cli._lr_decay_steps — iid shard
-    # sizes are ceil/floor(rows/n_clients), and sizing to the floor would
-    # let the bigger shard exhaust the schedule before the run ends)
-    max_shard = -(-len(gan_df) // n_clients)
-    decay_steps = epochs * max(1, max_shard // batch_size)
     _, init, trainer = _setup(
         n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend,
         df=gan_df, batch_size=batch_size, ema_decay=ema_decay,
-        seed=gan_seed, lr_schedule=lr_schedule,
-        lr_decay_steps=decay_steps if lr_schedule != "constant" else 0,
+        seed=gan_seed, lr_schedule=lr_schedule, lr_decay_epochs=epochs,
+        shard_strategy=shard_strategy, alpha=alpha,
     )
     cols = init.global_meta.column_names
     real_train = train_df[cols]
@@ -531,6 +544,14 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     u = utility_difference(
         real_train, raw, test_df[cols], "class", cat_cols,
     )
+    # similarity on the same final sample, vs the rows the GAN actually
+    # trained on (gan_df — differs from the full train split only under
+    # --train-rows) — so one run yields all three quality numbers
+    # (Avg_JSD / Avg_WD / delta-F1), which the non-IID aggregation
+    # comparison needs side by side
+    from fed_tgan_tpu.eval.similarity import statistical_similarity
+
+    avg_jsd, avg_wd, _ = statistical_similarity(gan_df[cols], raw, cat_cols)
     suffix = "" if weighted else "(uniform)"
     if select != "none":
         suffix += f"({select}-selected round {best_round})"
@@ -544,11 +565,16 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         suffix += f"(seed={gan_seed})"
     if lr_schedule != "constant":
         suffix += f"(lr={lr_schedule})"
+    if shard_strategy != "iid":
+        suffix += f"({shard_strategy}" + (
+            f"-a{alpha:g})" if shard_strategy == "dirichlet" else ")")
     return {
         "metric": f"intrusion_{n_clients}client_delta_f1_at_{epochs}{suffix}",
         "value": round(float(u["delta_f1"]), 4),
         "unit": "delta_f1(real-synthetic; ref 0.0850 on 10x more data)",
         "vs_baseline": round(0.0850 - float(u["delta_f1"]), 4),
+        "final_avg_jsd": round(float(avg_jsd), 4),
+        "final_avg_wd": round(float(avg_wd), 4),
         "train_seconds": round(time.time() - t_start, 1),
     }
 
@@ -784,6 +810,18 @@ def main() -> int:
                     help="utility workload: per-round EMA of the aggregated "
                          "generator; sampling/eval use the smoothed model "
                          "(0 = off, the reference protocol)")
+    ap.add_argument("--shard-strategy", default="iid",
+                    choices=["iid", "contiguous", "label_sorted",
+                             "dirichlet"],
+                    help="utility workload: how the table splits across "
+                         "clients (same strategies as the CLI; "
+                         "dirichlet/label_sorted key on the 'class' "
+                         "column) — the non-IID axis for the weighted-vs-"
+                         "uniform aggregation comparison")
+    ap.add_argument("--alpha", type=float, default=0.5,
+                    help="utility workload: Dirichlet concentration for "
+                         "--shard-strategy dirichlet (smaller = more "
+                         "label skew per client)")
     ap.add_argument("--sample-every", type=int, default=1, metavar="N",
                     help="full500 workload: write the snapshot CSV only "
                          "every Nth round plus the final round (default 1 "
@@ -873,6 +911,7 @@ def main() -> int:
             train_rows=args.train_rows, batch_size=args.batch_size,
             ema_decay=args.ema_decay, gan_seed=args.gan_seed,
             lr_schedule=args.lr_schedule,
+            shard_strategy=args.shard_strategy, alpha=args.alpha,
         )
     elif args.workload == "multihost":
         out = bench_multihost(epochs)

@@ -74,6 +74,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shard-strategy", type=str, default="iid",
                    choices=["iid", "contiguous", "label_sorted", "dirichlet"])
     p.add_argument("--alpha", type=float, default=0.5, help="dirichlet skew")
+    p.add_argument("--allow-zero-step-clients", action="store_true",
+                   help="let clients whose shard holds fewer than "
+                        "batch-size rows participate with 0 local steps "
+                        "(the reference's silent behavior under extreme "
+                        "non-IID splits; without this flag such a shard "
+                        "is rejected as a misconfiguration)")
     p.add_argument("--uniform", action="store_true",
                    help="uniform FedAvg instead of similarity-weighted")
     p.add_argument("--mode", type=str, default="fedavg",
@@ -579,7 +585,8 @@ def main(argv=None) -> int:
                       ema_decay=args.ema_decay,
                       lr_schedule=args.lr_schedule,
                       lr_decay_steps=_lr_decay_steps(
-                          args, max(len(f) for f in frames)))
+                          args, max(len(f) for f in frames)),
+                      allow_zero_step_clients=args.allow_zero_step_clients)
     if args.mode == "standalone":
         # no participants, no harmonization/refit protocol — skip the
         # federated construction entirely

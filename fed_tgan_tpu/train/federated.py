@@ -71,8 +71,10 @@ def build_client_stacks(init: FederatedInit, cfg: TrainConfig, spec: SegmentSpec
     trainer engines: (cond_stack, rows_stack, data_stack, steps, server_cond).
 
     ``steps`` follows the reference's ``len(train) // batch_size`` per client
-    (distributed.py:304); a shard smaller than one batch would train 0 steps,
-    which the reference silently allows but we reject."""
+    (distributed.py:304); a shard smaller than one batch trains 0 steps,
+    which the reference silently allows — here that needs the explicit
+    ``cfg.allow_zero_step_clients`` opt-in (skewed non-IID splits), and is
+    otherwise rejected as a misconfiguration."""
     conds = [CondSampler.from_data(m, spec) for m in init.client_matrices]
     rows = [RowSampler.from_data(m, spec) for m in init.client_matrices]
     cond_stack = _stack_samplers(conds)
@@ -84,12 +86,13 @@ def build_client_stacks(init: FederatedInit, cfg: TrainConfig, spec: SegmentSpec
     steps = np.asarray(
         [len(m) // cfg.batch_size for m in init.client_matrices], dtype=np.int32
     )
-    if (steps == 0).any():
+    if (steps == 0).any() and not cfg.allow_zero_step_clients:
         small = [i for i, s in enumerate(steps) if s == 0]
         raise ValueError(
             f"clients {small} hold fewer than batch_size={cfg.batch_size} rows "
-            "(reference behavior: they would train 0 steps); rebalance shards "
-            "or shrink the batch"
+            "(reference behavior: they would train 0 steps); rebalance shards, "
+            "shrink the batch, or opt in with "
+            "TrainConfig(allow_zero_step_clients=True)"
         )
     # generation-time conditional draws use the pooled empirical frequencies
     # (the reference server rebuilds Cond on the full training table,

@@ -46,6 +46,7 @@ from fed_tgan_tpu.train.snapshots import AsyncWorker
 from fed_tgan_tpu.train.steps import (
     SampleProgramCache,
     TrainConfig,
+    config_signature,
     init_models,
 )
 
@@ -109,7 +110,7 @@ def _save_participant(run: MultihostRun, rank: int, models_g, chain,
         "rank": rank,
         "seed": run.seed,
         "n_clients": n_clients,
-        "config": repr(cfg),
+        "config": config_signature(cfg),
         "epochs_done": epochs_done,
         "models": local_shard(models_g),
         "chain": np.asarray(kd.addressable_shards[0].data),
@@ -141,7 +142,7 @@ def _load_participant(run: MultihostRun, rank: int, n_clients: int,
     with open(_ckpt_path(run, rank), "rb") as f:
         state = pickle.load(f)
     want = {"rank": rank, "seed": run.seed, "n_clients": n_clients,
-            "config": repr(cfg)}
+            "config": config_signature(cfg)}
     got = {k: state.get(k) for k in want}
     if got != want:
         diffs = {k: (got[k], want[k]) for k in want if got[k] != want[k]}
@@ -201,12 +202,13 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     matrix = np.asarray(init_out["matrix"], dtype=np.float32)
     steps_local = len(matrix) // cfg.batch_size
     steps_all = [r // cfg.batch_size for r in rows_per_client]
-    if min(steps_all) == 0:
+    if min(steps_all) == 0 and not cfg.allow_zero_step_clients:
         small = [i for i, s in enumerate(steps_all) if s == 0]
         raise ValueError(
             f"clients {small} hold fewer than batch_size={cfg.batch_size} rows "
-            "(reference behavior: they would train 0 steps); rebalance shards "
-            "or shrink the batch"
+            "(reference behavior: they would train 0 steps); rebalance shards, "
+            "shrink the batch, or opt in with "
+            "TrainConfig(allow_zero_step_clients=True)"
         )
     max_steps = max(steps_all)
     max_rows = max(rows_per_client)

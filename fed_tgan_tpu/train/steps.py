@@ -16,6 +16,7 @@ on device with zero host round-trips.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from typing import Any, NamedTuple
 
@@ -64,6 +65,27 @@ class TrainConfig:
     lr_schedule: str = "constant"
     lr_decay_steps: int = 0
     lr_end_frac: float = 0.0
+    # Let clients whose shard holds fewer than batch_size rows participate
+    # with 0 local steps — the reference's silent behavior under extreme
+    # non-IID splits (steps = len(train)//batch_size, distributed.py:304:
+    # the client skips training but its synced model still enters FedAvg).
+    # Off by default: an all-IID run hitting this is a misconfiguration,
+    # so the loud guard stays unless the caller opts into skewed shards.
+    allow_zero_step_clients: bool = False
+
+
+def config_signature(cfg: TrainConfig) -> str:
+    """Canonical identity string for checkpoint compatibility checks:
+    only fields that DIFFER from the dataclass default are listed, so
+    adding a new default-valued knob to TrainConfig (trajectory-identical
+    by construction) never invalidates existing checkpoints the way a raw
+    ``repr(cfg)`` comparison would."""
+    diffs = [
+        f"{f.name}={getattr(cfg, f.name)!r}"
+        for f in dataclasses.fields(cfg)
+        if getattr(cfg, f.name) != f.default
+    ]
+    return f"TrainConfig({', '.join(diffs)})"
 
 
 class ModelBundle(NamedTuple):

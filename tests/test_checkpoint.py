@@ -183,3 +183,23 @@ def test_synthesizer_artifact_bakes_debiased_ema(fed_init, tmp_path):
     )
     raw = tr.sample_encoded(80, seed=2, use_ema=False)
     assert not np.allclose(tr.sample_encoded(80, seed=2), raw, atol=1e-5)
+
+
+def test_config_signature_ignores_default_valued_fields():
+    """Checkpoint config identity must be stable under ADDING a new
+    default-valued TrainConfig knob (trajectory-identical by construction):
+    only non-default fields enter the signature."""
+    import dataclasses
+
+    from fed_tgan_tpu.train.steps import TrainConfig, config_signature
+
+    base = TrainConfig()
+    assert config_signature(base) == "TrainConfig()"
+    # explicitly passing a default value changes nothing
+    assert config_signature(TrainConfig(ema_decay=0.0)) == "TrainConfig()"
+    tweaked = dataclasses.replace(base, batch_size=250, ema_decay=0.99)
+    sig = config_signature(tweaked)
+    assert "batch_size=250" in sig and "ema_decay=0.99" in sig
+    assert "allow_zero_step_clients" not in sig  # default-valued
+    # a REAL config difference still fails the equality check
+    assert sig != config_signature(base)

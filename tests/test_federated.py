@@ -1,5 +1,7 @@
 """Federated trainer on the 8-virtual-device CPU mesh."""
 
+import dataclasses
+
 import jax
 import numpy as np
 import pytest
@@ -372,3 +374,46 @@ class TestLRSchedule:
         np.testing.assert_array_equal(per_client, 2 * tr.steps)
         pg = np.asarray(jax.tree.leaves(tr.models.params_g)[0])
         assert np.allclose(pg[0], pg[1], atol=1e-6)
+
+
+def test_zero_step_client_opt_in(toy_frame, toy_spec):
+    """With ``allow_zero_step_clients=True`` a sub-batch shard participates
+    the reference way: 0 local steps, its contribution to the round's
+    uniform average is exactly the PREVIOUS model (not training on padded
+    garbage).  Verified by manual replay: agg == (trained_client0 + init)/2."""
+    import jax.numpy as jnp
+
+    from fed_tgan_tpu.train.steps import ModelBundle, make_train_step
+
+    frames = shard_dataframe(toy_frame, 2, "contiguous", seed=0)
+    frames[1] = frames[1].head(20)  # below batch_size=40 -> 0 steps
+    clients = [TablePreprocessor(frame=f, name="toy", **toy_spec) for f in frames]
+    init = federated_initialize(clients, seed=0, weighted=False)
+    cfg = dataclasses.replace(CFG, allow_zero_step_clients=True)
+    tr = FederatedTrainer(init, config=cfg, seed=0)
+    assert list(tr.steps) == [7, 0]
+    models0 = jax.tree.map(np.copy, tr.models)
+    tr.fit(1)
+    leaves = jax.tree.leaves(tr.models)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    agg = np.asarray(jax.tree.leaves(tr.models.params_g)[0][0])
+
+    # manual replay of client 0 (same key schedule as
+    # test_weighted_matches_manual_average); client 1 trains 0 steps, so
+    # under uniform weights the aggregate is the midpoint with the init
+    step = make_train_step(tr.spec, tr.cfg)
+    ekey = jax.random.split(jax.random.split(jax.random.key(0))[0])[1]
+    m = ModelBundle(*jax.tree.map(lambda x: jnp.asarray(x[0]), models0))
+    kc = jax.random.fold_in(ekey, 0)
+    for s in range(int(tr.steps[0])):
+        m, _ = step(
+            m,
+            jnp.asarray(tr.data_stack[0]),
+            jax.tree.map(lambda x: jnp.asarray(x[0]), tr.cond_stack),
+            jax.tree.map(lambda x: jnp.asarray(x[0]), tr.rows_stack),
+            jax.random.fold_in(kc, s),
+        )
+    trained = np.asarray(jax.tree.leaves(m.params_g)[0])
+    init_leaf = np.asarray(jax.tree.leaves(models0.params_g)[0][1])
+    assert np.allclose(agg, 0.5 * (trained + init_leaf), atol=1e-4)
+
