@@ -203,7 +203,7 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
            bgm_backend: str = "sklearn", df=None, batch_size: int = 500,
            ema_decay: float = 0.0, lr_schedule: str = "constant",
            lr_decay_epochs: int = 0, shard_strategy: str = "iid",
-           alpha: float = 0.5):
+           alpha: float = 0.5, d_steps: int = 1, pac: int = 10):
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -222,13 +222,13 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
     frames = shard_dataframe(df, n_clients, shard_strategy,
                              label_column=label_col, alpha=alpha, seed=seed)
     # the decay spans the whole run: sized to the LARGEST client's actual
-    # optimizer-step count (same intent as cli._lr_decay_steps) — computed
-    # HERE, from the real shard sizes, because non-IID strategies make the
-    # biggest shard much larger than ceil(rows/n_clients)
-    lr_decay_steps = 0
-    if lr_schedule != "constant" and lr_decay_epochs:
-        max_shard = max(len(f) for f in frames)
-        lr_decay_steps = lr_decay_epochs * max(1, max_shard // batch_size)
+    # shard (non-IID strategies make it much larger than
+    # ceil(rows/n_clients)); the horizon formula is shared with the CLI
+    from fed_tgan_tpu.train.steps import lr_decay_horizon
+
+    lr_decay_steps = lr_decay_horizon(
+        lr_schedule, lr_decay_epochs, max(len(f) for f in frames), batch_size
+    ) if lr_decay_epochs else 0
     clients = [
         TablePreprocessor(frame=f, name="Intrusion", selected_columns=selected, **kwargs)
         for f in frames
@@ -240,6 +240,7 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
         init, config=TrainConfig(batch_size=batch_size, ema_decay=ema_decay,
                                  lr_schedule=lr_schedule,
                                  lr_decay_steps=lr_decay_steps,
+                                 d_steps=d_steps, pac=pac,
                                  # skewed splits can leave a client under
                                  # one batch; the reference lets it ride
                                  # with 0 local steps, and the non-IID
@@ -397,7 +398,8 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
                   select: str = "none", train_rows: int | None = None,
                   batch_size: int = 500, ema_decay: float = 0.0,
                   gan_seed: int = 0, lr_schedule: str = "constant",
-                  shard_strategy: str = "iid", alpha: float = 0.5) -> dict:
+                  shard_strategy: str = "iid", alpha: float = 0.5,
+                  d_steps: int = 1, pac: int = 10) -> dict:
     """Driver-reproducible ΔF1: the reference utility_analysis protocol
     (reference Server/utility_analysis.py:94-119, README.md:67 headline
     0.0850 at 500 epochs on the FULL training CSV).
@@ -446,7 +448,7 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend,
         df=gan_df, batch_size=batch_size, ema_decay=ema_decay,
         seed=gan_seed, lr_schedule=lr_schedule, lr_decay_epochs=epochs,
-        shard_strategy=shard_strategy, alpha=alpha,
+        shard_strategy=shard_strategy, alpha=alpha, d_steps=d_steps, pac=pac,
     )
     cols = init.global_meta.column_names
     real_train = train_df[cols]
@@ -565,6 +567,10 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         suffix += f"(seed={gan_seed})"
     if lr_schedule != "constant":
         suffix += f"(lr={lr_schedule})"
+    if d_steps != 1:
+        suffix += f"(d_steps={d_steps})"
+    if pac != 10:
+        suffix += f"(pac={pac})"
     if shard_strategy != "iid":
         suffix += f"({shard_strategy}" + (
             f"-a{alpha:g})" if shard_strategy == "dirichlet" else ")")
@@ -810,6 +816,14 @@ def main() -> int:
                     help="utility workload: per-round EMA of the aggregated "
                          "generator; sampling/eval use the smoothed model "
                          "(0 = off, the reference protocol)")
+    ap.add_argument("--d-steps", type=int, default=1,
+                    help="utility workload: critic updates per generator "
+                         "update (WGAN n_critic; reference uses 1) — "
+                         "G-step-budget-neutral quality lever")
+    ap.add_argument("--pac", type=int, default=10,
+                    help="utility workload: discriminator packing size "
+                         "(reference 10); smaller pac gives more pac-"
+                         "groups per critic batch at small batch sizes")
     ap.add_argument("--shard-strategy", default="iid",
                     choices=["iid", "contiguous", "label_sorted",
                              "dirichlet"],
@@ -858,11 +872,15 @@ def main() -> int:
                  "FED_TGAN_BENCH_CSV at a copy")
     if args.sample_every < 1:
         ap.error(f"--sample-every {args.sample_every}: must be >= 1")
-    if args.batch_size <= 0 or args.batch_size % 10:
+    if args.pac <= 0:
+        ap.error(f"--pac {args.pac}: must be positive")
+    if args.d_steps < 1:
+        ap.error(f"--d-steps {args.d_steps}: must be >= 1")
+    if args.batch_size <= 0 or args.batch_size % args.pac:
         ap.error(f"--batch-size {args.batch_size}: must be a positive "
-                 "multiple of pac=10 (the discriminator packs rows in "
-                 "groups of 10, reference Server/dtds/synthesizers/"
-                 "ctgan.py:28-30)")
+                 f"multiple of pac={args.pac} (the discriminator packs "
+                 "rows in groups of pac, reference Server/dtds/"
+                 "synthesizers/ctgan.py:28-30)")
     if not 0.0 <= args.ema_decay < 1.0:
         ap.error(f"--ema-decay {args.ema_decay}: must be in [0, 1)")
     if args.ema_decay > 0 and args.select != "none":
@@ -912,6 +930,7 @@ def main() -> int:
             ema_decay=args.ema_decay, gan_seed=args.gan_seed,
             lr_schedule=args.lr_schedule,
             shard_strategy=args.shard_strategy, alpha=args.alpha,
+            d_steps=args.d_steps, pac=args.pac,
         )
     elif args.workload == "multihost":
         out = bench_multihost(epochs)

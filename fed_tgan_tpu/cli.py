@@ -327,6 +327,7 @@ def _run_multihost_init(args) -> int:
                     lr_schedule=args.lr_schedule,
                     lr_decay_steps=_lr_decay_steps(
                         args, max(int(r) for r in out["rows_per_client"])),
+                    allow_zero_step_clients=args.allow_zero_step_clients,
                 )
                 client_train(t, out, cfg, make_run())
                 print(f"rank {args.rank} training complete")
@@ -334,12 +335,10 @@ def _run_multihost_init(args) -> int:
 
 
 def _lr_decay_steps(args, max_shard_rows: int) -> int:
-    """Decay horizon in optimizer steps: the largest client's step count at
-    the final epoch (smaller shards advance the schedule slower — counts
-    only grow on real steps).  0 when the schedule is constant."""
-    if args.lr_schedule == "constant":
-        return 0
-    return args.epochs * max(1, max_shard_rows // args.batch_size)
+    from fed_tgan_tpu.train.steps import lr_decay_horizon
+
+    return lr_decay_horizon(
+        args.lr_schedule, args.epochs, max_shard_rows, args.batch_size)
 
 
 def _eval_categorical_columns(kwargs) -> list:

@@ -66,4 +66,15 @@ def shard_dataframe(
 ) -> list[pd.DataFrame]:
     labels = df[label_column].to_numpy() if label_column else None
     parts = shard_indices(len(df), n_clients, strategy, labels, alpha, seed)
+    empty = [i for i, idx in enumerate(parts) if len(idx) == 0]
+    if empty:
+        # a 0-ROW client can't even fit its feature transformers — fail
+        # here with guidance instead of deep inside sklearn (0-step
+        # clients with >=1 row are a separate, supported case:
+        # TrainConfig.allow_zero_step_clients)
+        raise ValueError(
+            f"clients {empty} received 0 rows under strategy={strategy!r} "
+            f"(alpha={alpha}, seed={seed}); raise alpha, reduce n_clients, "
+            "or change the shard seed"
+        )
     return [df.iloc[idx].reset_index(drop=True) for idx in parts]

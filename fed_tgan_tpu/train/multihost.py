@@ -144,6 +144,10 @@ def _load_participant(run: MultihostRun, rank: int, n_clients: int,
     want = {"rank": rank, "seed": run.seed, "n_clients": n_clients,
             "config": config_signature(cfg)}
     got = {k: state.get(k) for k in want}
+    if got["config"] == repr(cfg):
+        # legacy checkpoint written before the non-default-field signature:
+        # the full repr matching the CURRENT config is the same guarantee
+        got["config"] = want["config"]
     if got != want:
         diffs = {k: (got[k], want[k]) for k in want if got[k] != want[k]}
         raise RuntimeError(

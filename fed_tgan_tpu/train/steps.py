@@ -65,6 +65,12 @@ class TrainConfig:
     lr_schedule: str = "constant"
     lr_decay_steps: int = 0
     lr_end_frac: float = 0.0
+    # Critic iterations per generator step (WGAN-style n_critic).  1 = the
+    # reference's alternating schedule (bit-identical trajectory to
+    # pre-knob builds).  >1 runs extra D updates, each on a fresh batch,
+    # before every G update — step-budget-neutral on the G side (an epoch
+    # still advances the generator len(shard)//batch times).
+    d_steps: int = 1
     # Let clients whose shard holds fewer than batch_size rows participate
     # with 0 local steps — the reference's silent behavior under extreme
     # non-IID splits (steps = len(train)//batch_size, distributed.py:304:
@@ -72,6 +78,17 @@ class TrainConfig:
     # Off by default: an all-IID run hitting this is a misconfiguration,
     # so the loud guard stays unless the caller opts into skewed shards.
     allow_zero_step_clients: bool = False
+
+
+def lr_decay_horizon(lr_schedule: str, epochs: int, max_shard_rows: int,
+                     batch_size: int) -> int:
+    """Decay horizon in optimizer steps, shared by the CLI and the bench:
+    the LARGEST client's step count at the final epoch (smaller shards
+    advance the schedule slower — counts only grow on real steps).  0 when
+    the schedule is constant."""
+    if lr_schedule == "constant":
+        return 0
+    return epochs * max(1, max_shard_rows // batch_size)
 
 
 def config_signature(cfg: TrainConfig) -> str:
@@ -169,46 +186,66 @@ def make_train_step(spec: SegmentSpec, cfg: TrainConfig):
     def step(models: ModelBundle, data, cond: CondSampler, rows: RowSampler, key):
         keys = jax.random.split(key, 13)
 
-        # ------------------------------------------------ discriminator step
-        z = jax.random.normal(keys[0], (B, cfg.embedding_dim))
-        if has_cond:
-            c1, m1, col, opt_idx = cond.sample_train(keys[1], B)
-            perm = jax.random.permutation(keys[2], B)
-            row_idx = rows.sample_rows(keys[3], col[perm], opt_idx[perm])
-            c2 = c1[perm]
-            gen_in = jnp.concatenate([z, c1], axis=1)
+        # ------------------------------------------- discriminator step(s)
+        def d_update(params_d, opt_d_state, state_g, dk):
+            """One critic update on a fresh batch; ``dk`` is 9 keys laid
+            out exactly like keys[0:9] of the reference-faithful single-
+            critic path, so d_steps=1 stays bit-identical."""
+            z = jax.random.normal(dk[0], (B, cfg.embedding_dim))
+            if has_cond:
+                c1, m1, col, opt_idx = cond.sample_train(dk[1], B)
+                perm = jax.random.permutation(dk[2], B)
+                row_idx = rows.sample_rows(dk[3], col[perm], opt_idx[perm])
+                c2 = c1[perm]
+                gen_in = jnp.concatenate([z, c1], axis=1)
+            else:
+                row_idx = rows.sample_uniform(dk[3], B)
+                gen_in = z
+            real = data[row_idx]
+
+            fake_raw, state_g2 = generator_apply(
+                models.params_g, state_g, gen_in, train=True)
+            fake_act = apply_activate(fake_raw, spec, dk[4])
+            if has_cond:
+                fake_cat = jnp.concatenate([fake_act, c1], axis=1)
+                real_cat = jnp.concatenate([real, c2], axis=1)
+            else:
+                fake_cat, real_cat = fake_act, real
+            fake_cat = jax.lax.stop_gradient(fake_cat)
+
+            def d_loss_fn(params_d):
+                y_fake = discriminator_apply(params_d, fake_cat, dk[5], cfg.pac)
+                y_real = discriminator_apply(params_d, real_cat, dk[6], cfg.pac)
+                loss_d = jnp.mean(y_fake) - jnp.mean(y_real)
+                pen = gradient_penalty(
+                    lambda x: discriminator_apply(params_d, x, dk[7], cfg.pac),
+                    real_cat,
+                    fake_cat,
+                    dk[8],
+                    pac=cfg.pac,
+                )
+                return loss_d + pen, (loss_d, pen)
+
+            (_, (loss_d, pen)), grads_d = jax.value_and_grad(
+                d_loss_fn, has_aux=True)(params_d)
+            upd_d, opt_d_state = opt_d.update(grads_d, opt_d_state, params_d)
+            params_d = optax.apply_updates(params_d, upd_d)
+            return params_d, opt_d_state, state_g2, loss_d, pen
+
+        params_d, opt_d_state, state_g2 = (
+            models.params_d, models.opt_d, models.state_g)
+        if cfg.d_steps == 1:
+            d_key_sets = [keys[:9]]
         else:
-            row_idx = rows.sample_uniform(keys[3], B)
-            gen_in = z
-        real = data[row_idx]
-
-        fake_raw, state_g2 = generator_apply(models.params_g, models.state_g, gen_in, train=True)
-        fake_act = apply_activate(fake_raw, spec, keys[4])
-        if has_cond:
-            fake_cat = jnp.concatenate([fake_act, c1], axis=1)
-            real_cat = jnp.concatenate([real, c2], axis=1)
-        else:
-            fake_cat, real_cat = fake_act, real
-        fake_cat = jax.lax.stop_gradient(fake_cat)
-
-        def d_loss_fn(params_d):
-            y_fake = discriminator_apply(params_d, fake_cat, keys[5], cfg.pac)
-            y_real = discriminator_apply(params_d, real_cat, keys[6], cfg.pac)
-            loss_d = jnp.mean(y_fake) - jnp.mean(y_real)
-            pen = gradient_penalty(
-                lambda x: discriminator_apply(params_d, x, keys[7], cfg.pac),
-                real_cat,
-                fake_cat,
-                keys[8],
-                pac=cfg.pac,
-            )
-            return loss_d + pen, (loss_d, pen)
-
-        (_, (loss_d, pen)), grads_d = jax.value_and_grad(d_loss_fn, has_aux=True)(
-            models.params_d
-        )
-        upd_d, opt_d_state = opt_d.update(grads_d, models.opt_d, models.params_d)
-        params_d = optax.apply_updates(models.params_d, upd_d)
+            # extra critic iterations draw fresh key blocks off keys[0];
+            # the unrolled loop stays one fused device program
+            d_key_sets = [
+                jax.random.split(jax.random.fold_in(keys[0], it), 9)
+                for it in range(cfg.d_steps)
+            ]
+        for dk in d_key_sets:
+            params_d, opt_d_state, state_g2, loss_d, pen = d_update(
+                params_d, opt_d_state, state_g2, dk)
 
         # ---------------------------------------------------- generator step
         z2 = jax.random.normal(keys[9], (B, cfg.embedding_dim))

@@ -1,0 +1,12 @@
+#!/bin/bash
+# weighted-vs-uniform aggregation under Dirichlet label skew (VERDICT r3 #2)
+cd /root/repo
+for alpha in 0.1 0.5 2.0; do
+  for mode in "" "--uniform"; do
+    echo "=== alpha=$alpha mode=${mode:-weighted} $(date -u +%H:%M:%S)" >> noniid_out/sweep.log
+    python bench.py --workload utility --epochs 500 --clients 8 \
+      --shard-strategy dirichlet --alpha $alpha $mode --backend cpu \
+      2>>noniid_out/sweep.log | tail -1 >> noniid_out/sweep_results.jsonl
+  done
+done
+echo "SWEEP DONE $(date -u +%H:%M:%S)" >> noniid_out/sweep.log

@@ -139,8 +139,15 @@ def test_sharding_strategies(toy_frame):
     skew = shard_indices(100, 2, "label_sorted", labels=labels)
     assert (labels[skew[0]] == 0).all()
 
-    dfs = shard_dataframe(toy_frame, 4, "dirichlet", label_column="flag", alpha=0.1, seed=3)
+    dfs = shard_dataframe(toy_frame, 4, "dirichlet", label_column="flag", alpha=0.5, seed=0)
     assert sum(len(d) for d in dfs) == len(toy_frame)
+    assert all(len(d) > 0 for d in dfs)
+
+    # extreme skew CAN hand a client 0 rows (binary labels, alpha=0.1,
+    # seed 3 does); that must fail fast with guidance, not deep in sklearn
+    with pytest.raises(ValueError, match="received 0 rows"):
+        shard_dataframe(toy_frame, 4, "dirichlet", label_column="flag",
+                        alpha=0.1, seed=3)
 
 
 def test_write_artifacts_trio(tmp_path, toy_frame):
