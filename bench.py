@@ -612,8 +612,155 @@ def _covertype_like(n: int, seed: int = 7):
     })
 
 
+def _adult_like(n: int, seed: int = 11):
+    """Synthetic Adult-census-shaped table (BASELINE.md config 4): the
+    ADULT preset's full mixed schema (6 continuous incl. two zero-inflated
+    capital columns, 9 categoricals) with a logistic income label driven by
+    age/education/hours/capital-gain, at any row count (48,842 = the real
+    dataset's size).  The real CSV is absent in this offline sandbox
+    (PARITY.md; scripts/fetch_datasets.py fetches it elsewhere); the SHAPE
+    and the non-IID label-shard protocol are what config 4 exercises."""
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    age = np.clip(rng.normal(38.6, 13.7, n), 17, 90).round()
+    edu_num = np.clip(rng.normal(10.1, 2.6, n), 1, 16).round()
+    edu_names = np.array([
+        "Preschool", "1st-4th", "5th-6th", "7th-8th", "9th", "10th",
+        "11th", "12th", "HS-grad", "Some-college", "Assoc-voc",
+        "Assoc-acdm", "Bachelors", "Masters", "Prof-school", "Doctorate",
+    ])
+    hours = np.clip(rng.normal(40.4, 12.3, n), 1, 99).round()
+    gain = np.where(rng.random(n) < 0.083,
+                    np.exp(rng.normal(7.6, 1.3, n)), 0.0).round()
+    loss = np.where(rng.random(n) < 0.047,
+                    np.exp(rng.normal(7.4, 0.6, n)), 0.0).round()
+    sex = rng.choice(["Male", "Female"], n, p=[0.67, 0.33])
+    # income via a logistic in the drivers — classifiers have real signal
+    # to find, so delta-F1 measures generator fidelity, not label noise
+    logit = (0.035 * (age - 38) + 0.32 * (edu_num - 10)
+             + 0.03 * (hours - 40) + 0.9 * (gain > 0)
+             + 0.55 * (sex == "Male") - 1.45)
+    income = np.where(
+        rng.random(n) < 1.0 / (1.0 + np.exp(-logit)), ">50K", "<=50K")
+    return pd.DataFrame({
+        "age": age,
+        "workclass": rng.choice(
+            ["Private", "Self-emp-not-inc", "Self-emp-inc", "Federal-gov",
+             "Local-gov", "State-gov", "Without-pay", "Never-worked"],
+            n, p=[0.694, 0.079, 0.035, 0.029, 0.064, 0.041, 0.05, 0.008]),
+        "fnlwgt": np.exp(rng.normal(11.9, 0.5, n)).round(),
+        "education": edu_names[edu_num.astype(int) - 1],
+        "education-num": edu_num,
+        "marital-status": rng.choice(
+            ["Married-civ-spouse", "Never-married", "Divorced",
+             "Separated", "Widowed", "Married-spouse-absent",
+             "Married-AF-spouse"],
+            n, p=[0.458, 0.33, 0.136, 0.031, 0.031, 0.013, 0.001]),
+        "occupation": rng.choice(
+            ["Prof-specialty", "Craft-repair", "Exec-managerial",
+             "Adm-clerical", "Sales", "Other-service", "Machine-op-inspct",
+             "Transport-moving", "Handlers-cleaners", "Farming-fishing",
+             "Tech-support", "Protective-serv", "Priv-house-serv",
+             "Armed-Forces"],
+            n, p=[0.132, 0.13, 0.129, 0.12, 0.117, 0.106, 0.066,
+                  0.053, 0.047, 0.035, 0.028, 0.02, 0.016, 0.001]),
+        "relationship": rng.choice(
+            ["Husband", "Not-in-family", "Own-child", "Unmarried",
+             "Wife", "Other-relative"],
+            n, p=[0.404, 0.255, 0.155, 0.105, 0.048, 0.033]),
+        "race": rng.choice(
+            ["White", "Black", "Asian-Pac-Islander", "Amer-Indian-Eskimo",
+             "Other"], n, p=[0.855, 0.096, 0.031, 0.01, 0.008]),
+        "sex": sex,
+        "capital-gain": gain,
+        "capital-loss": loss,
+        "hours-per-week": hours,
+        "native-country": rng.choice(
+            ["United-States", "Mexico", "Philippines", "Germany", "Canada",
+             "Puerto-Rico", "El-Salvador", "India", "Cuba", "England",
+             "other"], n,
+            p=[0.895, 0.02, 0.006, 0.004, 0.004, 0.004, 0.003, 0.003,
+               0.003, 0.003, 0.055]),
+        "income": income,
+    })
+
+
+def bench_adult(epochs: int = 500, n_clients: int = 8,
+                rows: int = 48_842, weighted: bool = True,
+                bgm_backend: str = "sklearn", shard_strategy: str = "dirichlet",
+                alpha: float = 0.5, gan_seed: int = 0) -> dict:
+    """BASELINE.md config 4: Adult-shaped table, 8 clients, NON-IID label
+    shards, full quality row (Avg_JSD / Avg_WD / delta-F1).  70/30 split
+    before training; the GAN trains on the train side's non-IID shards and
+    the classifiers score on the untouched holdout — same protocol as the
+    utility workload, at Adult's full 48,842-row size."""
+    import pandas as pd
+
+    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.datasets import ADULT, preprocessor_kwargs
+    from fed_tgan_tpu.eval.similarity import statistical_similarity
+    from fed_tgan_tpu.eval.utility import utility_difference
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    t_start = time.time()
+    df = _adult_like(rows)
+    split = int(len(df) * 0.7)
+    train_df, test_df = df.iloc[:split], df.iloc[split:]
+    kwargs = preprocessor_kwargs(ADULT)
+    selected = kwargs.pop("selected_columns")
+    frames = shard_dataframe(
+        train_df, n_clients, shard_strategy, label_column="income",
+        alpha=alpha, seed=gan_seed,
+    )
+    clients = [
+        TablePreprocessor(frame=f, name="Adult", selected_columns=selected,
+                          **kwargs)
+        for f in frames
+    ]
+    init = federated_initialize(clients, seed=gan_seed, weighted=weighted,
+                                backend=bgm_backend)
+    trainer = FederatedTrainer(
+        init,
+        config=TrainConfig(allow_zero_step_clients=True),
+        seed=gan_seed,
+    )
+    t_init = time.time() - t_start
+    trainer.fit(epochs)  # hook-free: rounds fuse into device programs
+
+    cols = init.global_meta.column_names
+    cat_cols = init.global_meta.categorical_columns
+    real_train = train_df[cols]
+    raw = decode_matrix(
+        trainer.sample(len(real_train), seed=1), init.global_meta,
+        init.encoders,
+    )
+    avg_jsd, avg_wd, _ = statistical_similarity(real_train, raw, cat_cols)
+    u = utility_difference(real_train, raw, test_df[cols], "income", cat_cols)
+    suffix = "" if weighted else "(uniform)"
+    return {
+        "metric": (f"adult_noniid_{n_clients}client_delta_f1_at_{epochs}"
+                   f"({shard_strategy}-a{alpha:g}){suffix}"),
+        "value": round(float(u["delta_f1"]), 4),
+        "unit": ("delta_f1(real-synthetic; synthetic Adult-shaped table — "
+                 "no reference comparator, vs_baseline 0 by convention)"),
+        "vs_baseline": 0,
+        "final_avg_jsd": round(float(avg_jsd), 4),
+        "final_avg_wd": round(float(avg_wd), 4),
+        "init_seconds": round(t_init, 2),
+        "train_seconds": round(time.time() - t_start, 1),
+        "rows": rows,
+    }
+
+
 def bench_scale(epochs: int = 50, n_clients: int = 32,
-                rows: int = 580_000, bgm_backend: str = "jax") -> dict:
+                rows: int = 580_000, bgm_backend: str = "jax",
+                quality: bool = False) -> dict:
     """BASELINE.md config 5's shape at full scale: a Covertype-sized table
     (580k rows — the real dataset's size), 32 participants stacked
     k-per-device on the mesh, similarity-weighted aggregation, multiclass
@@ -634,6 +781,15 @@ def bench_scale(epochs: int = 50, n_clients: int = 32,
 
     t_start = time.time()
     df = _covertype_like(rows)
+    # quality mode (BASELINE config 5's ML-utility eval): hold out 30%
+    # BEFORE training so the multiclass delta-F1 scores rows the generator
+    # never saw; the timing semantics change (fewer train rows), so the
+    # metric name records the mode
+    if quality:
+        split = int(len(df) * 0.7)
+        gan_df, test_df = df.iloc[:split], df.iloc[split:]
+    else:
+        gan_df, test_df = df, None
     clients = [
         TablePreprocessor(
             frame=f, name="CovertypeScale",
@@ -642,7 +798,7 @@ def bench_scale(epochs: int = 50, n_clients: int = 32,
             target_column="Cover_Type",
             problem_type="multiclass_classification",
         )
-        for f in shard_dataframe(df, n_clients, "iid", seed=0)
+        for f in shard_dataframe(gan_df, n_clients, "iid", seed=0)
     ]
     init = federated_initialize(clients, seed=0, weighted=True,
                                 backend=bgm_backend)
@@ -657,8 +813,9 @@ def bench_scale(epochs: int = 50, n_clients: int = 32,
     t0 = time.time()
     trainer.fit(epochs)
     per_round = (time.time() - t0) / epochs
-    return {
-        "metric": f"covertype_scale_{n_clients}client_{rows}row_round_seconds",
+    out = {
+        "metric": (f"covertype_scale_{n_clients}client_{rows}row_round_"
+                   f"seconds{'(quality)' if quality else ''}"),
         "value": round(per_round, 4),
         "unit": "s/round (fused, snapshot-free; no reference comparator "
                 "at this scale, so vs_baseline is 0 by convention)",
@@ -667,6 +824,28 @@ def bench_scale(epochs: int = 50, n_clients: int = 32,
         "init_seconds": round(t_init, 2),
         "steps_per_client_per_round": int(trainer.max_steps),
     }
+    if quality:
+        from fed_tgan_tpu.data.decode import decode_matrix
+        from fed_tgan_tpu.eval.similarity import statistical_similarity
+        from fed_tgan_tpu.eval.utility import utility_difference
+
+        cols = init.global_meta.column_names
+        cat_cols = init.global_meta.categorical_columns
+        real_train = gan_df[cols]
+        # sample a train-sized synthetic table (multiple device programs;
+        # generation stays fused on device via make_sample_many)
+        raw = decode_matrix(
+            trainer.sample(len(real_train), seed=1), init.global_meta,
+            init.encoders,
+        )
+        avg_jsd, avg_wd, _ = statistical_similarity(real_train, raw, cat_cols)
+        u = utility_difference(
+            real_train, raw, test_df[cols], "Cover_Type", cat_cols)
+        out["final_avg_jsd"] = round(float(avg_jsd), 4)
+        out["final_avg_wd"] = round(float(avg_wd), 4)
+        out["delta_f1_multiclass"] = round(float(u["delta_f1"]), 4)
+        out["epochs"] = epochs
+    return out
 
 
 def bench_multihost(epochs: int = 10) -> dict:
@@ -768,11 +947,17 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload",
                     choices=["round", "full500", "utility", "multihost",
-                             "scale"],
+                             "scale", "adult"],
                     default="round")
-    ap.add_argument("--rows", type=int, default=580_000,
-                    help="scale workload: synthetic Covertype-like row "
-                         "count (580k = the real dataset's size)")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="scale/adult workloads: synthetic table row count "
+                         "(defaults: 580k Covertype / 48,842 Adult — the "
+                         "real datasets' sizes)")
+    ap.add_argument("--quality", action="store_true",
+                    help="scale workload: hold out 30%% before training "
+                         "and report Avg_JSD/Avg_WD + multiclass delta-F1 "
+                         "after the timed rounds (BASELINE config 5's "
+                         "ML-utility eval)")
     ap.add_argument("--epochs", type=int, default=None,
                     help="number of rounds (default: 500 for "
                          "full500/utility, 10 for multihost)")
@@ -824,7 +1009,7 @@ def main() -> int:
                     help="utility workload: discriminator packing size "
                          "(reference 10); smaller pac gives more pac-"
                          "groups per critic batch at small batch sizes")
-    ap.add_argument("--shard-strategy", default="iid",
+    ap.add_argument("--shard-strategy", default=None,
                     choices=["iid", "contiguous", "label_sorted",
                              "dirichlet"],
                     help="utility workload: how the table splits across "
@@ -867,7 +1052,8 @@ def main() -> int:
         CSV_PATH = args.csv
     # scale generates its own synthetic Covertype-like table and never
     # reads the Intrusion CSV — don't require it there
-    if args.workload != "scale" and not os.path.exists(CSV_PATH):
+    if args.workload not in ("scale", "adult") \
+            and not os.path.exists(CSV_PATH):
         ap.error(f"Intrusion CSV not found at {CSV_PATH}; point --csv or "
                  "FED_TGAN_BENCH_CSV at a copy")
     if args.sample_every < 1:
@@ -881,6 +1067,22 @@ def main() -> int:
                  f"multiple of pac={args.pac} (the discriminator packs "
                  "rows in groups of pac, reference Server/dtds/"
                  "synthesizers/ctgan.py:28-30)")
+    # these knobs are consumed ONLY by the utility workload's TrainConfig;
+    # silently accepting them elsewhere would run a default config while
+    # the metric name suggests otherwise
+    utility_only = {"--batch-size": args.batch_size != 500,
+                    "--ema-decay": args.ema_decay > 0,
+                    "--lr-schedule": args.lr_schedule != "constant",
+                    "--select": args.select != "none",
+                    "--train-rows": args.train_rows is not None,
+                    "--d-steps": args.d_steps != 1,
+                    "--pac": args.pac != 10}
+    misapplied = [k for k, used in utility_only.items() if used]
+    if misapplied and args.workload != "utility":
+        ap.error(f"{', '.join(misapplied)} only apply to "
+                 f"--workload utility (got {args.workload})")
+    if args.gan_seed != 0 and args.workload not in ("utility", "adult"):
+        ap.error("--gan-seed only applies to the utility/adult workloads")
     if not 0.0 <= args.ema_decay < 1.0:
         ap.error(f"--ema-decay {args.ema_decay}: must be in [0, 1)")
     if args.ema_decay > 0 and args.select != "none":
@@ -889,8 +1091,9 @@ def main() -> int:
                  "and the selection modes stash/restore raw model state")
     bgm = args.bgm_backend or (
         "jax" if args.workload == "scale" else "sklearn")
-    clients = args.clients if args.clients is not None else (
-        32 if args.workload == "scale" else 2)
+    clients = args.clients if args.clients is not None else {
+        "scale": 32, "adult": 8
+    }.get(args.workload, 2)
     # multihost is CPU-gloo by construction: no accelerator probe, no tag
     if args.backend == "cpu":
         import jax
@@ -912,11 +1115,18 @@ def main() -> int:
     epochs = args.epochs if args.epochs is not None else {
         "multihost": 10, "scale": 50
     }.get(args.workload, 500)
+    rows = args.rows if args.rows is not None else (
+        48_842 if args.workload == "adult" else 580_000)
+    # config 4 is a NON-IID demo: the adult workload defaults to dirichlet
+    # label shards; utility keeps the reference-faithful iid default
+    shard_strategy = args.shard_strategy or (
+        "dirichlet" if args.workload == "adult" else "iid")
     # the 0.15 min/round calibration assumes the reference-shaped round
     # (~10k rows total); the scale workload's rounds carry ~rows/500 batch
     # steps, so widen the deadline proportionally — a legitimate big run
     # must never be killed as a false wedge
-    work_scale = (args.rows / 7_000.0) if args.workload == "scale" else 1.0
+    work_scale = (rows / 7_000.0) if args.workload in ("scale", "adult") \
+        else 1.0
     cancel_deadline = _arm_run_deadline(args.workload, tag, epochs,
                                         work_scale)
     if args.workload == "round":
@@ -929,14 +1139,22 @@ def main() -> int:
             train_rows=args.train_rows, batch_size=args.batch_size,
             ema_decay=args.ema_decay, gan_seed=args.gan_seed,
             lr_schedule=args.lr_schedule,
-            shard_strategy=args.shard_strategy, alpha=args.alpha,
+            shard_strategy=shard_strategy, alpha=args.alpha,
             d_steps=args.d_steps, pac=args.pac,
         )
     elif args.workload == "multihost":
         out = bench_multihost(epochs)
     elif args.workload == "scale":
         out = bench_scale(epochs, n_clients=clients,
-                          rows=args.rows, bgm_backend=bgm)
+                          rows=rows, bgm_backend=bgm,
+                          quality=args.quality)
+    elif args.workload == "adult":
+        out = bench_adult(
+            epochs, n_clients=clients, rows=rows,
+            weighted=not args.uniform, bgm_backend=bgm,
+            shard_strategy=shard_strategy, alpha=args.alpha,
+            gan_seed=args.gan_seed,
+        )
     else:
         out = bench_full500(
             epochs, n_clients=clients, weighted=not args.uniform,

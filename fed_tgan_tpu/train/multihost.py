@@ -46,6 +46,7 @@ from fed_tgan_tpu.train.snapshots import AsyncWorker
 from fed_tgan_tpu.train.steps import (
     SampleProgramCache,
     TrainConfig,
+    config_matches,
     config_signature,
     init_models,
 )
@@ -144,9 +145,10 @@ def _load_participant(run: MultihostRun, rank: int, n_clients: int,
     want = {"rank": rank, "seed": run.seed, "n_clients": n_clients,
             "config": config_signature(cfg)}
     got = {k: state.get(k) for k in want}
-    if got["config"] == repr(cfg):
-        # legacy checkpoint written before the non-default-field signature:
-        # the full repr matching the CURRENT config is the same guarantee
+    if isinstance(got["config"], str) and config_matches(got["config"], cfg):
+        # any historical storage form (canonical signature, full repr,
+        # legacy repr predating newer default-valued fields) describing
+        # THIS config is the same compatibility guarantee
         got["config"] = want["config"]
     if got != want:
         diffs = {k: (got[k], want[k]) for k in want if got[k] != want[k]}

@@ -105,6 +105,60 @@ def config_signature(cfg: TrainConfig) -> str:
     return f"TrainConfig({', '.join(diffs)})"
 
 
+def _split_top_level(s: str) -> list[str]:
+    """Split 'a=1, b=(2, 3)' on commas OUTSIDE parens/brackets/quotes."""
+    parts, depth, start, quote = [], 0, 0, ""
+    for i, ch in enumerate(s):
+        if quote:
+            if ch == quote:
+                quote = ""
+        elif ch in "\"'":
+            quote = ch
+        elif ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    tail = s[start:].strip()
+    if tail:
+        parts.append(tail)
+    return [p.strip() for p in parts]
+
+
+def config_matches(saved: str, cfg: TrainConfig) -> bool:
+    """Whether a checkpoint's stored config string describes ``cfg``.
+
+    Accepts every historical storage form without false positives: the
+    canonical non-default signature, a full ``repr(cfg)``, and legacy
+    full reprs written BEFORE newer default-valued fields existed.  Rule:
+    every ``name=value`` pair in the saved string must name a current
+    field whose live value reprs identically, and every current field the
+    saved string does NOT mention must sit at its default (a legacy
+    checkpoint can only have meant the default for a knob that didn't
+    exist yet)."""
+    saved = saved.strip()
+    if not (saved.startswith("TrainConfig(") and saved.endswith(")")):
+        return False
+    by_name = {f.name: f for f in dataclasses.fields(cfg)}
+    mentioned = set()
+    for pair in _split_top_level(saved[len("TrainConfig("):-1]):
+        if not pair:
+            continue
+        name, eq, value = pair.partition("=")
+        name = name.strip()
+        if not eq or name not in by_name:
+            return False
+        if value.strip() != repr(getattr(cfg, name)):
+            return False
+        mentioned.add(name)
+    return all(
+        getattr(cfg, f.name) == f.default
+        for f in dataclasses.fields(cfg) if f.name not in mentioned
+    )
+
+
 class ModelBundle(NamedTuple):
     """Everything that evolves during training (one client's worth)."""
 
@@ -179,6 +233,9 @@ def make_train_step(spec: SegmentSpec, cfg: TrainConfig):
 
     ``data`` is this client's transformed matrix (possibly padded — the row
     sampler only ever indexes real rows)."""
+    if cfg.d_steps < 1:
+        raise ValueError(f"d_steps={cfg.d_steps}: need >= 1 critic "
+                         "update per generator step")
     opt_g, opt_d = make_optimizers(cfg)
     B = cfg.batch_size
     has_cond = spec.n_discrete > 0

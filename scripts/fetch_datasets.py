@@ -1,0 +1,104 @@
+"""Fetch the real Adult and Covertype tables (BASELINE.md configs 4-5).
+
+This build sandbox has zero network egress, so the in-repo quality rows for
+configs 4-5 run on full-size synthetic look-alikes (`bench.py --workload
+adult` / `--workload scale --quality`; see PARITY.md).  On a connected
+machine, this script downloads the real datasets and writes CSVs the same
+workloads accept via ``--csv``-style overrides:
+
+    python scripts/fetch_datasets.py --out data/
+    python bench.py --workload adult --adult-csv data/adult.csv   # planned
+    python -m fed_tgan_tpu.cli --dataset adult --datapath data/adult.csv ...
+
+The CLI path works today: presets `adult` / `covertype` in
+fed_tgan_tpu/datasets.py carry the schemas; only the file is needed.
+"""
+from __future__ import annotations
+
+import argparse
+import gzip
+import io
+import os
+import urllib.request
+
+ADULT_URL = ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+             "adult/adult.data")
+ADULT_TEST_URL = ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+                  "adult/adult.test")
+COVERTYPE_URL = ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+                 "covtype/covtype.data.gz")
+
+ADULT_COLUMNS = [
+    "age", "workclass", "fnlwgt", "education", "education-num",
+    "marital-status", "occupation", "relationship", "race", "sex",
+    "capital-gain", "capital-loss", "hours-per-week", "native-country",
+    "income",
+]
+# covtype.data: 10 continuous, 4 one-hot wilderness, 40 one-hot soil, target
+COVERTYPE_CONTINUOUS = [
+    "Elevation", "Aspect", "Slope", "Horizontal_Distance_To_Hydrology",
+    "Vertical_Distance_To_Hydrology", "Horizontal_Distance_To_Roadways",
+    "Hillshade_9am", "Hillshade_Noon", "Hillshade_3pm",
+    "Horizontal_Distance_To_Fire_Points",
+]
+
+
+def fetch_adult(out_dir: str) -> str:
+    import pandas as pd
+
+    frames = []
+    for url, skip in ((ADULT_URL, 0), (ADULT_TEST_URL, 1)):
+        raw = urllib.request.urlopen(url, timeout=60).read().decode()
+        df = pd.read_csv(io.StringIO(raw), header=None, names=ADULT_COLUMNS,
+                         skiprows=skip, skipinitialspace=True)
+        # the test split suffixes labels with '.'
+        df["income"] = df["income"].str.rstrip(".")
+        frames.append(df.dropna())
+    out = os.path.join(out_dir, "adult.csv")
+    pd.concat(frames, ignore_index=True).to_csv(out, index=False)
+    return out
+
+
+def fetch_covertype(out_dir: str) -> str:
+    import pandas as pd
+
+    raw = urllib.request.urlopen(COVERTYPE_URL, timeout=120).read()
+    df = pd.read_csv(io.BytesIO(gzip.decompress(raw)), header=None)
+    # collapse the reference-unfriendly one-hot blocks into two categorical
+    # columns (the shape the scale workload's schema uses)
+    wild = df.iloc[:, 10:14].to_numpy().argmax(axis=1)
+    soil = df.iloc[:, 14:54].to_numpy().argmax(axis=1)
+    tidy = df.iloc[:, :10].copy()
+    tidy.columns = COVERTYPE_CONTINUOUS
+    tidy["Wilderness_Area"] = [f"area{i}" for i in wild]
+    tidy["Soil_Type"] = [f"type{i}" for i in soil]
+    tidy["Cover_Type"] = df.iloc[:, 54].astype(str)
+    out = os.path.join(out_dir, "covertype.csv")
+    tidy.to_csv(out, index=False)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data")
+    ap.add_argument("--datasets", default="adult,covertype",
+                    help="comma list: adult, covertype")
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    for name in (d.strip() for d in args.datasets.split(",") if d.strip()):
+        try:
+            path = {"adult": fetch_adult,
+                    "covertype": fetch_covertype}[name](args.out)
+        except KeyError:
+            print(f"unknown dataset {name!r}")
+            return 2
+        except OSError as exc:
+            print(f"{name}: fetch failed ({exc}) — this sandbox may have "
+                  "no network egress; run on a connected machine")
+            return 1
+        print(f"{name}: wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -203,3 +203,35 @@ def test_config_signature_ignores_default_valued_fields():
     assert "allow_zero_step_clients" not in sig  # default-valued
     # a REAL config difference still fails the equality check
     assert sig != config_signature(base)
+
+
+def test_config_matches_accepts_every_storage_era():
+    """Checkpoint config strings from every era must validate against the
+    config they describe — and only that config: (1) the canonical
+    non-default signature, (2) a full current repr, (3) a LEGACY full repr
+    written before newer default-valued fields (d_steps,
+    allow_zero_step_clients) existed."""
+    from fed_tgan_tpu.train.steps import (
+        TrainConfig,
+        config_matches,
+        config_signature,
+    )
+
+    cfg = TrainConfig(batch_size=250, ema_decay=0.99)
+    assert config_matches(config_signature(cfg), cfg)
+    assert config_matches(repr(cfg), cfg)
+    # legacy repr: all pre-era fields spelled out, new knobs absent
+    legacy = ("TrainConfig(embedding_dim=128, gen_dims=(256, 256), "
+              "dis_dims=(256, 256), batch_size=250, pac=10, "
+              "l2scale=1e-06, lr=0.0002, beta1=0.5, beta2=0.9, "
+              "ema_decay=0.99, lr_schedule='constant', lr_decay_steps=0, "
+              "lr_end_frac=0.0)")
+    assert config_matches(legacy, cfg)
+    # a legacy string can only mean DEFAULTS for knobs it predates: a
+    # current config with d_steps=2 must NOT match it
+    assert not config_matches(
+        legacy, TrainConfig(batch_size=250, ema_decay=0.99, d_steps=2))
+    # and a real difference in a mentioned field fails
+    assert not config_matches(legacy, TrainConfig(batch_size=500,
+                                                  ema_decay=0.99))
+    assert not config_matches("garbage", cfg)
