@@ -58,6 +58,16 @@ SPECIAL = {
     # round-3 tunnel (PARITY.md); trajectory and final quality identical
     # to the dense run
     "full500s": ["--workload", "full500", "--sample-every", "25"],
+    # BASELINE config 4: full-size Adult-shaped non-IID quality row —
+    # ~68 fused steps/round is cheap on the chip, prohibitive on the
+    # 1-core CPU fallback
+    "adult500": ["--workload", "adult"],
+    # BASELINE config 5 incl. the ML-utility eval at full 580k-row scale
+    "scaleq": ["--workload", "scale", "--quality"],
+    # headline round with a jax.profiler device trace — the attribution
+    # data (device compute vs D2H vs dispatch) the sub-0.3 s/round attack
+    # needs; runs LAST so a trace failure can't cost plain captures
+    "roundprof": ["--profile-dir", "profile_r04"],
 }
 
 
