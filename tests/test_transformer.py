@@ -111,3 +111,21 @@ def test_refit_with_global_agrees_across_clients(bimodal):
     assert tfs[0].output_info == tfs[1].output_info
     # global i2s order 'b','a' -> slot 0 holds code of 'b' (=1)
     assert tfs[0].columns[1].codes.tolist() == [1, 0]
+
+
+def test_bgm_convergence_env_knobs(monkeypatch):
+    """FED_TGAN_TPU_BGM_MAX_ITER / _TOL reach the sklearn estimator
+    (experiment levers; defaults = the reference's exact settings)."""
+    import numpy as np
+
+    from fed_tgan_tpu.features.bgm import fit_column_gmm
+
+    rng = np.random.default_rng(5)
+    x = np.concatenate([rng.normal(0, 1, 200), rng.normal(6, 0.4, 200)])
+    base = fit_column_gmm(x, seed=0)
+    monkeypatch.setenv("FED_TGAN_TPU_BGM_MAX_ITER", "2")
+    truncated = fit_column_gmm(x, seed=0)
+    assert not np.allclose(base.weights, truncated.weights)
+    monkeypatch.setenv("FED_TGAN_TPU_BGM_MAX_ITER", "not-a-number")
+    fallback = fit_column_gmm(x, seed=0)  # ignored, defaults apply
+    assert np.allclose(base.weights, fallback.weights)
