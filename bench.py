@@ -574,6 +574,12 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
     if shard_strategy != "iid":
         suffix += f"({shard_strategy}" + (
             f"-a{alpha:g})" if shard_strategy == "dirichlet" else ")")
+    # the BGM convergence env levers change the init, so the metric name
+    # must record them (features/bgm.py fit_column_gmm)
+    bgm_iter = os.environ.get("FED_TGAN_TPU_BGM_MAX_ITER")
+    bgm_tol = os.environ.get("FED_TGAN_TPU_BGM_TOL")
+    if bgm_iter or bgm_tol:
+        suffix += f"(bgm_iter={bgm_iter or 100},tol={bgm_tol or '1e-3'})"
     return {
         "metric": f"intrusion_{n_clients}client_delta_f1_at_{epochs}{suffix}",
         "value": round(float(u["delta_f1"]), 4),
