@@ -83,7 +83,13 @@ def _ensure_responsive_backend() -> str:
     return "(cpu-fallback)"
 
 
-_EVIDENCE_MAX_AGE_S = 24 * 3600.0  # one round horizon
+# Evidence older than this is not attached at all.  72 h spans a round
+# horizon even when the tunnel stays wedged across a whole session (the
+# round-3→4 boundary measured exactly that: the next session's bench ran
+# ~24.5 h after the last healthy capture, just past the old 24 h cap);
+# within the window the rider stays honest by carrying capture time AND
+# age at attach (see below).
+_EVIDENCE_MAX_AGE_S = 72 * 3600.0
 
 
 def _attach_tpu_evidence(out: dict, tag: str,
@@ -106,8 +112,11 @@ def _attach_tpu_evidence(out: dict, tag: str,
         import calendar
         captured = calendar.timegm(time.strptime(
             rec["captured_utc"], "%Y-%m-%dT%H:%M:%SZ"))
-        if time.time() - captured > _EVIDENCE_MAX_AGE_S:
+        age_s = time.time() - captured
+        if age_s > _EVIDENCE_MAX_AGE_S:
             return
+        rec = dict(rec)
+        rec["age_hours_at_attach"] = round(age_s / 3600.0, 1)
         out["tpu_evidence_prior_capture"] = rec
     except (OSError, json.JSONDecodeError, KeyError, ValueError):
         pass

@@ -155,22 +155,29 @@ def test_bench_setup_batch_size_raises_step_budget():
 def test_bench_attaches_tpu_evidence_on_fallback(tmp_path):
     """Bench lines that could not measure the chip (cpu-fallback, wedged
     mid-run) carry the standing healthy-window TPU capture under a key that
-    names it prior evidence; healthy and explicit-cpu runs don't, and stale
-    (>24 h) or unstamped captures are never attached."""
+    names it prior evidence — including its age at attach time; healthy
+    and explicit-cpu runs don't, and stale (>72 h) or unstamped captures
+    are never attached."""
     import importlib
     import json as _json
     import time as _time
 
     bench = importlib.import_module("bench")
     ev = tmp_path / "TPU_EVIDENCE.json"
-    fresh = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    fresh = _time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() - 25 * 3600))
     ev.write_text(_json.dumps(
         {"value": 0.8, "vs_baseline": 30.0, "captured_utc": fresh}))
 
+    # 25 h old: inside the 72 h window (a wedged round can easily push the
+    # next driver bench past 24 h — the round-3→4 boundary did), and the
+    # rider self-reports its age
     for tag in ("(cpu-fallback)", "(wedged-mid-run)"):
         out = {"metric": f"m{tag}"}
         bench._attach_tpu_evidence(out, tag, ev_path=str(ev))
         assert out["tpu_evidence_prior_capture"]["value"] == 0.8
+        assert 24.5 < out["tpu_evidence_prior_capture"][
+            "age_hours_at_attach"] < 25.5
 
     for tag in ("", "(cpu)"):
         clean = {"metric": "m"}
@@ -178,7 +185,7 @@ def test_bench_attaches_tpu_evidence_on_fallback(tmp_path):
         assert "tpu_evidence_prior_capture" not in clean
 
     stale = _time.strftime(
-        "%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() - 48 * 3600))
+        "%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() - 80 * 3600))
     ev.write_text(_json.dumps(
         {"value": 0.8, "vs_baseline": 30.0, "captured_utc": stale}))
     out = {"metric": "m(cpu-fallback)"}
