@@ -279,7 +279,10 @@ class RoundBookkeeping:
         """block_until_ready with the shared failure contract: on a device/
         runtime failure the chunk's outputs are error-poisoned, so restore
         last-good state (``rollback``) and drop any predispatched snapshot
-        of the poisoned arrays before re-raising."""
+        of the poisoned arrays before re-raising.  ``arrays`` may be any
+        output (or subset) of the chunk's program — error-poisoning covers
+        every output of a failed executable, so syncing one cheap scalar
+        is equivalent to syncing the full state pytree."""
         try:
             jax.block_until_ready(arrays)
         except Exception:
@@ -539,7 +542,10 @@ class FederatedTrainer(RoundBookkeeping):
                 (self.models, self._key, self.ema,
                  self._ema_updates) = prev
 
-            self._sync_or_rollback(models, _rollback, sample_hook)
+            # sync on the cheap already-in-flight finite scalar — contract-
+            # equivalent to syncing the full pytree (see _sync_or_rollback);
+            # measured wall-neutral on the tunneled chip (PARITY.md)
+            self._sync_or_rollback(finite, _rollback, sample_hook)
             ok = on_nonfinite == "ignore" or bool(finite)
             if not ok:
                 self._check_finite(metrics, e, on_nonfinite)
