@@ -245,8 +245,10 @@ def test_d_steps_knob():
     spec = SegmentSpec.from_output_info(OUT_INFO)
     rng = np.random.default_rng(3)
     data = jnp.asarray(rng.normal(size=(120, spec.dim)).astype(np.float32))
-    cond = CondSampler.from_data(np.asarray(data), spec)
-    rows = RowSampler.from_data(np.asarray(data), spec)
+    # samplers expect one-hot-ish non-negative discrete blocks; |data| keeps
+    # the counts valid without changing what the step function sees
+    cond = CondSampler.from_data(np.abs(np.asarray(data)), spec)
+    rows = RowSampler.from_data(np.abs(np.asarray(data)), spec)
     cfg1 = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
                        batch_size=40, pac=4)
     cfg2 = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
