@@ -1,0 +1,98 @@
+"""Summarize a jax.profiler trace into the round-attribution numbers.
+
+Parses the Chrome-trace JSON that ``bench.py --profile-dir DIR`` leaves
+under ``DIR/plugins/profile/*/vm.trace.json.gz`` and prints one JSON
+object with the totals PARITY.md's trace-attribution section is built
+from: per-device-line busy time, top device modules, and the host-side
+hotspots (sync, predispatch, writer decode/CSV).  Raw traces are ~18 MB
+each and session-local scratch (gitignored); this extraction is the
+committed evidence.
+
+Usage: python scripts/trace_attribution.py profile_r04 [...more dirs]
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+# host-side frames worth reporting, keyed by a substring of the trace name
+HOST_PATTERNS = (
+    "block_until_ready",
+    "_sync_or_rollback",
+    "_maybe_predispatch",
+    "predispatch",
+    "decode_matrix",
+    "write_csv",
+    "fit",
+)
+
+
+def summarize(profile_dir: str) -> dict:
+    paths = glob.glob(
+        os.path.join(profile_dir, "plugins", "profile", "*", "*.trace.json.gz")
+    )
+    if not paths:
+        raise FileNotFoundError(f"no trace under {profile_dir}")
+    # timestamped subdirs sort lexicographically = chronologically; always
+    # read the LATEST so regenerated evidence matches the newest run
+    paths = sorted(paths)[-1:]
+    with gzip.open(paths[0]) as fh:
+        events = json.load(fh)["traceEvents"]
+
+    proc_names: dict[int, str] = {}
+    thread_names: dict[tuple[int, int], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc_names[e["pid"]] = e["args"]["name"]
+        elif e.get("name") == "thread_name":
+            thread_names[(e["pid"], e["tid"])] = e["args"]["name"]
+    device_pids = {p for p, n in proc_names.items() if "TPU" in n or "device" in n}
+
+    device_lines: collections.Counter = collections.Counter()
+    device_modules: collections.Counter = collections.Counter()
+    host: collections.Counter = collections.Counter()
+    host_counts: collections.Counter = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        dur = e.get("dur", 0)
+        if e["pid"] in device_pids:
+            line = thread_names.get((e["pid"], e["tid"]), str(e["tid"]))
+            device_lines[line] += dur
+            if line == "XLA Modules":
+                device_modules[e["name"].split("(")[0]] += dur
+        else:
+            name = e["name"]
+            if any(p in name for p in HOST_PATTERNS):
+                host[name] += dur
+                host_counts[name] += 1
+    return {
+        "trace": paths[0],
+        "device_busy_ms": {k: round(v / 1e3, 1) for k, v in device_lines.items()},
+        "device_modules_ms": {
+            k: round(v / 1e3, 1) for k, v in device_modules.most_common(8)
+        },
+        "host_hotspots_ms": {
+            k: {"total": round(v / 1e3, 1), "count": host_counts[k]}
+            for k, v in host.most_common(12)
+        },
+    }
+
+
+def main() -> int:
+    if not sys.argv[1:]:
+        print("usage: trace_attribution.py PROFILE_DIR [...]", file=sys.stderr)
+        return 2
+    out = {d: summarize(d) for d in sys.argv[1:]}
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
