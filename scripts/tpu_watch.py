@@ -77,6 +77,11 @@ SPECIAL = {
                    "--sample-every", "25"],
     "full500s8u": ["--workload", "full500", "--clients", "8", "--uniform",
                    "--sample-every", "25"],
+    # the 1500-epoch quality config's third seed (seeds 0-1 captured in
+    # the round-4 window before a re-wedge hung seed 2 mid-run)
+    "utility1500s2": ["--workload", "utility", "--epochs", "1500",
+                      "--batch-size", "250", "--ema-decay", "0.99",
+                      "--gan-seed", "2"],
 }
 
 

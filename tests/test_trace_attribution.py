@@ -1,0 +1,74 @@
+"""scripts/trace_attribution.py — the committed-evidence extractor."""
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "trace_attribution.py")
+# contained import (tests/test_graft_entry.py pattern): scripts/ must not
+# linger on sys.path for the rest of the session
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+try:
+    import trace_attribution  # noqa: E402
+finally:
+    sys.path.pop(0)
+
+
+def _write_trace(profile_dir, stamp, events):
+    d = os.path.join(profile_dir, "plugins", "profile", stamp)
+    os.makedirs(d)
+    with gzip.open(os.path.join(d, "vm.trace.json.gz"), "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+
+
+def _events(sync_us):
+    return [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 3, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 7, "tid": 2, "name": "thread_name",
+         "args": {"name": "python"}},
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 0, "dur": 5000,
+         "name": "jit_epoch_local(123)"},
+        {"ph": "X", "pid": 7, "tid": 2, "ts": 0, "dur": sync_us,
+         "name": "$federated.py:278 _sync_or_rollback"},
+        # host frame not matching any pattern must be dropped
+        {"ph": "X", "pid": 7, "tid": 2, "ts": 0, "dur": 99999,
+         "name": "$something.py:1 irrelevant"},
+    ]
+
+
+def test_summarize_extracts_device_and_host_totals(tmp_path):
+    _write_trace(str(tmp_path), "2026_01_01_00_00_00", _events(40000))
+    out = trace_attribution.summarize(str(tmp_path))
+    assert out["device_modules_ms"] == {"jit_epoch_local": 5.0}
+    assert out["device_busy_ms"] == {"XLA Modules": 5.0}
+    hot = out["host_hotspots_ms"]["$federated.py:278 _sync_or_rollback"]
+    assert hot == {"total": 40.0, "count": 1}
+    assert "$something.py:1 irrelevant" not in out["host_hotspots_ms"]
+
+
+def test_summarize_reads_latest_trace_only(tmp_path):
+    # two timestamped runs: the extractor must read the NEWER one
+    _write_trace(str(tmp_path), "2026_01_01_00_00_00", _events(10000))
+    _write_trace(str(tmp_path), "2026_01_02_00_00_00", _events(70000))
+    out = trace_attribution.summarize(str(tmp_path))
+    hot = out["host_hotspots_ms"]["$federated.py:278 _sync_or_rollback"]
+    assert hot["total"] == 70.0
+    assert "2026_01_02_00_00_00" in out["trace"]
+
+
+def test_missing_dir_raises_and_no_args_is_usage_error(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        trace_attribution.summarize(str(tmp_path / "nope"))
+    proc = subprocess.run([sys.executable, SCRIPT], capture_output=True,
+                          text=True)
+    assert proc.returncode == 2
+    assert "usage" in proc.stderr
