@@ -311,7 +311,7 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
 
 def bench_full500(
     epochs: int = 500,
-    out_dir: str = "bench_full500_out",
+    out_dir: str | None = None,
     n_clients: int = 2,
     weighted: bool = True,
     bgm_backend: str = "sklearn",
@@ -337,6 +337,13 @@ def bench_full500(
         raise ValueError("full500 workload needs epochs >= 1")
     if sample_every < 1:
         raise ValueError("sample_every must be >= 1")
+    if out_dir is None:
+        # per-config scratch dir: back-to-back runs of different configs
+        # (e.g. the watcher's weighted/uniform 8-client pair) must not
+        # clobber each other's snapshot CSVs and timing files
+        out_dir = (f"bench_full500_out"
+                   f"{'' if n_clients == 2 else f'_c{n_clients}'}"
+                   f"{'' if weighted else '_uniform'}")
     t_start = time.time()
     df, init, trainer = _setup(
         n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend

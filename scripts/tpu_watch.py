@@ -69,10 +69,8 @@ SPECIAL = {
     # needs; runs LAST so a trace failure can't cost plain captures
     "roundprof": ["--profile-dir", "profile_r04"],
     # BASELINE configs 3 and 2 (weighted / uniform 8-client 500-epoch
-    # Intrusion) with sparse snapshots so each fits a short window.
-    # NOTE: both reuse bench.py's scratch dir bench_full500_out/, so the
-    # second run clobbers the first's snapshot/timing artifacts — the
-    # captured evidence is the JSON line, not the scratch dir
+    # Intrusion) with sparse snapshots so each fits a short window; each
+    # config writes its own bench_full500_out* scratch dir
     "full500s8w": ["--workload", "full500", "--clients", "8",
                    "--sample-every", "25"],
     "full500s8u": ["--workload", "full500", "--clients", "8", "--uniform",
