@@ -765,6 +765,10 @@ def bench_adult(epochs: int = 500, n_clients: int = 8,
     avg_jsd, avg_wd, _ = statistical_similarity(real_train, raw, cat_cols)
     u = utility_difference(real_train, raw, test_df[cols], "income", cat_cols)
     suffix = "" if weighted else "(uniform)"
+    if gan_seed:
+        # same convention as the utility workload: non-default seeds are
+        # visible in the metric name so evidence lines are self-describing
+        suffix += f"(seed={gan_seed})"
     return {
         "metric": (f"adult_noniid_{n_clients}client_delta_f1_at_{epochs}"
                    f"({shard_strategy}-a{alpha:g}){suffix}"),
