@@ -94,14 +94,17 @@ _EVIDENCE_MAX_AGE_S = 72 * 3600.0
 
 def _attach_tpu_evidence(out: dict, tag: str,
                          ev_path: str | None = None) -> None:
-    """On a run that could not measure the chip — cpu-fallback (wedged at
-    probe time) or wedged-mid-run (the BENCH_r02 failure mode) — attach the
-    standing healthy-window TPU capture (TPU_EVIDENCE.json, maintained by
-    scripts/tpu_watch.py and manual captures) to the JSON line.  The key
-    says "prior_capture": it is earlier evidence, not this run's
-    measurement, and captures older than 24 h are not attached at all (a
-    stale number must not masquerade as current-round evidence)."""
-    if tag not in ("(cpu-fallback)", "(wedged-mid-run)"):
+    """On a run that could not measure the chip, attach the standing
+    healthy-window TPU capture (TPU_EVIDENCE.json, maintained by
+    scripts/tpu_watch.py and manual captures) to the JSON line.  Accepted
+    tags are exactly the three no-chip-number outcomes: cpu-fallback
+    (wedged at probe time), wedged-mid-run (the deadline fired — the
+    BENCH_r02 failure mode) and wedged-fast-fail (backend UNAVAILABLE
+    mid-run).  The key says "prior_capture": it is earlier evidence, not
+    this run's measurement, and captures older than the age cap are not
+    attached at all (a stale number must not masquerade as current-round
+    evidence)."""
+    if tag not in ("(cpu-fallback)", "(wedged-mid-run)", "(wedged-fast-fail)"):
         return
     if ev_path is None:
         ev_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1191,11 +1194,39 @@ def main() -> int:
 
 
 def _is_backend_unavailable(exc: BaseException) -> bool:
-    """True for the error shapes a mid-run tunnel wedge fast-fails with."""
+    """True for the error shapes a mid-run tunnel wedge fast-fails with.
+
+    Two gates must BOTH pass (ADVICE r04): the exception type is a
+    backend/transport error family (JAX runtime, XLA/grpc, OS socket), and
+    its text carries a tunnel-wedge marker.  A plain application exception
+    whose message merely quotes a marker (e.g. a ValueError mentioning
+    UNAVAILABLE) re-raises instead of being swallowed into an exit-0
+    'no perf claim' record.
+    """
     text = f"{type(exc).__name__}: {exc}"
     markers = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "remote_compile",
                "Connection refused", "Socket closed", "failed to connect")
-    return any(m in text for m in markers)
+    if not any(m in text for m in markers):
+        return False
+    types: tuple = (OSError,)  # ConnectionError et al. are OSError subclasses
+    try:
+        import jax
+
+        types += (jax.errors.JaxRuntimeError,)
+    except Exception:  # noqa: BLE001 — jax import must not mask the gate
+        pass
+    qualname = f"{type(exc).__module__}.{type(exc).__name__}"
+    if isinstance(exc, types) or any(
+            part in qualname for part in ("jaxlib", "jax.", "xla", "grpc")):
+        return True
+    # jax surfaces backend-init failures as builtins.RuntimeError ("Unable
+    # to initialize backend 'tpu': UNAVAILABLE: ..."), and bench_multihost
+    # wraps a wedged rank's log tail in one — accept plain RuntimeError only
+    # for the unambiguous backend-status markers, so an application
+    # RuntimeError merely mentioning e.g. remote_compile still re-raises
+    return isinstance(exc, RuntimeError) and any(
+        m in str(exc) for m in ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                                "Unable to initialize backend"))
 
 
 def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):

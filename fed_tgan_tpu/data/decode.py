@@ -61,10 +61,9 @@ def decode_to_table(
     for i, name in enumerate(meta.column_names):
         x = data[:, i]
         if name in enc_by_name:
-            classes = enc_by_name[name].classes_
-            codes = x.astype(np.int32)
-            if codes.size and (codes.min() < 0 or codes.max() >= len(classes)):
-                raise ValueError("category code out of range")
+            enc = enc_by_name[name]
+            classes = enc.classes_
+            codes = enc.validate_codes(x).astype(np.int32)
             # the missing token decodes to ' ' (decode_matrix's mapping) —
             # applied on the small dictionary, never on the 40k rows
             cats = [" " if c == MISSING_TOKEN else str(c) for c in classes]

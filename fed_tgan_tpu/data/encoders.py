@@ -36,11 +36,17 @@ class CategoryEncoder:
             raise ValueError(f"unknown categories: {unknown[:10]}")
         return codes.astype(np.int64)
 
-    def inverse_transform(self, codes) -> np.ndarray:
+    def validate_codes(self, codes) -> np.ndarray:
+        """Range-checked int64 codes, without materializing the category
+        values — the shared gate for every decode path (an int32 cast before
+        the check could wrap an out-of-range float into the valid range)."""
         codes = np.asarray(codes, dtype=np.int64)
         if codes.size and (codes.min() < 0 or codes.max() >= len(self.classes_)):
             raise ValueError("category code out of range")
-        return self.classes_[codes]
+        return codes
+
+    def inverse_transform(self, codes) -> np.ndarray:
+        return self.classes_[self.validate_codes(codes)]
 
     def __len__(self) -> int:
         return len(self.classes_)

@@ -459,6 +459,24 @@ def test_decode_to_table_fallback_conditions(trainer):
         assert decode_to_table(bad, meta, init.encoders) is None
 
 
+def test_decode_to_table_rejects_int32_wrapping_codes(trainer):
+    """A wildly out-of-range category value (e.g. 3e9) must raise like
+    decode_matrix's int64 path does, not wrap through an int32 cast into a
+    silently-wrong category (ADVICE r04)."""
+    import numpy as np
+    import pytest
+
+    from fed_tgan_tpu.data.decode import decode_to_table
+
+    init = trainer.init
+    meta = init.global_meta
+    mat = np.asarray(trainer.sample(16, seed=0)).copy()
+    cat_idx = meta.column_names.index(meta.categorical_columns[0])
+    mat[0, cat_idx] = 3e9  # wraps to a small positive int under int32
+    with pytest.raises(ValueError, match="out of range"):
+        decode_to_table(mat, meta, init.encoders)
+
+
 def test_decode_to_table_maps_missing_token_in_dictionary():
     """'empty' categories decode to ' ' exactly like decode_matrix."""
     import numpy as np

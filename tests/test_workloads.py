@@ -172,7 +172,7 @@ def test_bench_attaches_tpu_evidence_on_fallback(tmp_path):
     # 25 h old: inside the 72 h window (a wedged round can easily push the
     # next driver bench past 24 h — the round-3→4 boundary did), and the
     # rider self-reports its age
-    for tag in ("(cpu-fallback)", "(wedged-mid-run)"):
+    for tag in ("(cpu-fallback)", "(wedged-mid-run)", "(wedged-fast-fail)"):
         out = {"metric": f"m{tag}"}
         bench._attach_tpu_evidence(out, tag, ev_path=str(ev))
         assert out["tpu_evidence_prior_capture"]["value"] == 0.8
@@ -201,3 +201,36 @@ def test_bench_attaches_tpu_evidence_on_fallback(tmp_path):
     bench._attach_tpu_evidence(
         missing, "(cpu-fallback)", ev_path=str(tmp_path / "absent.json"))
     assert "tpu_evidence_prior_capture" not in missing
+
+
+def test_backend_unavailable_requires_backend_error_type():
+    """The fast-fail wedge filter needs BOTH a transport/runtime error type
+    and a wedge marker in the text (ADVICE r04): an application ValueError
+    that merely quotes UNAVAILABLE must re-raise, not become an exit-0
+    'no perf claim' record."""
+    import importlib
+
+    import jax
+
+    bench = importlib.import_module("bench")
+    # marker + backend type -> swallowed
+    assert bench._is_backend_unavailable(
+        jax.errors.JaxRuntimeError("UNAVAILABLE: TPU backend setup error"))
+    assert bench._is_backend_unavailable(
+        ConnectionRefusedError("Connection refused by tunnel endpoint"))
+    # marker but plain application exception -> re-raise
+    assert not bench._is_backend_unavailable(
+        ValueError("config field UNAVAILABLE is not a number"))
+    assert not bench._is_backend_unavailable(
+        RuntimeError("remote_compile cache miss"))
+    # backend type but no marker -> re-raise (a real IO bug, not a wedge)
+    assert not bench._is_backend_unavailable(OSError("disk quota exceeded"))
+    # plain RuntimeError IS accepted for the unambiguous backend-status
+    # texts: jax's backend-init failure and bench_multihost's wrap of a
+    # wedged rank's log tail both arrive as builtins.RuntimeError
+    assert bench._is_backend_unavailable(RuntimeError(
+        "Unable to initialize backend 'tpu': UNAVAILABLE: endpoint down"))
+    assert bench._is_backend_unavailable(RuntimeError(
+        "multihost rank 1 failed:\n... UNAVAILABLE: Socket closed ..."))
+    assert not bench._is_backend_unavailable(RuntimeError(
+        "multihost rank 1 failed:\n... port already in use ..."))
