@@ -132,11 +132,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run similarity analysis against the training data at the end")
     p.add_argument("--decode", choices=["exact", "packed16", "packed8"],
                    default=None,
-                   help="snapshot transfer layout (default packed16): "
-                        "exact = bit-stable vs the f32 on-device decode; "
-                        "packed8 = halve the continuous block on "
-                        "transfer-starved links (error <= 4 sigma/127). "
-                        "Equivalent to FED_TGAN_TPU_DECODE")
+                   help="snapshot transfer layout (default packed8, the "
+                        "transfer-minimal layout — drift vs packed16 "
+                        "bounded metric-identical over the full 500-epoch "
+                        "protocol, see PARITY.md): exact = bit-stable vs "
+                        "the f32 on-device decode; packed16 = 1e-4-of-"
+                        "sigma quantization. Equivalent to "
+                        "FED_TGAN_TPU_DECODE")
     p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler (TensorBoard) trace of the "
                         "LAST --profile-rounds training rounds into DIR — "

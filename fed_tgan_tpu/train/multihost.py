@@ -328,7 +328,7 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
     # protocol (the reference server's full-table Cond, distributed.py:565-580)
     pooled_cond = CondSampler.from_counts(init_out["cond_counts"], spec)
     # snapshots ship in the same transfer-minimal layout as the single-host
-    # path (default packed16, FED_TGAN_TPU_DECODE selects): rank 1 sends the
+    # path (default packed8, FED_TGAN_TPU_DECODE selects): rank 1 sends the
     # mu/sigma denorm tables ONCE with the first snapshot, after which every
     # 40k-row payload is ~25-40% smaller on the wire than the exact f32
     # layout; ``exact`` keeps the meta-only decode (bit-stable CSVs).

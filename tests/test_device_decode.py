@@ -116,13 +116,17 @@ def test_assemble_for_meta_matches_transformer_layout():
 
 def test_select_snapshot_decode_env_switch(monkeypatch):
     """FED_TGAN_TPU_EXACT_DECODE=1 routes trainers to the bit-exact packed
-    decode (parts keyed cont/disc); the default stays packed16 (u/k/disc)."""
+    decode (parts keyed cont/disc); the default is packed8 (u/k/disc with
+    int8 u — the transfer-minimal layout, drift-bounded in round 4)."""
     from fed_tgan_tpu.ops.decode import select_snapshot_decode
 
     tf, enc = _fitted()
     monkeypatch.delenv("FED_TGAN_TPU_EXACT_DECODE", raising=False)
+    monkeypatch.delenv("FED_TGAN_TPU_DECODE", raising=False)
     decode_fn, _ = select_snapshot_decode(tf.columns)
-    assert set(jax.jit(decode_fn)(enc)) == {"u", "k", "disc"}
+    default_parts = jax.tree.map(np.asarray, jax.jit(decode_fn)(enc))
+    assert set(default_parts) == {"u", "k", "disc"}
+    assert default_parts["u"].dtype == np.int8
 
     monkeypatch.setenv("FED_TGAN_TPU_EXACT_DECODE", "1")
     decode_fn, assemble = select_snapshot_decode(tf.columns)
@@ -149,14 +153,14 @@ def test_packed8_decode_within_quantization_error():
     assert np.abs(out[:, 0] - full[:, 0]).max() <= tol
 
 
-def test_select_snapshot_decode_packed8_and_bad_mode(monkeypatch):
+def test_select_snapshot_decode_packed16_and_bad_mode(monkeypatch):
     from fed_tgan_tpu.ops.decode import select_snapshot_decode
 
     tf, enc = _fitted()
-    monkeypatch.setenv("FED_TGAN_TPU_DECODE", "packed8")
+    monkeypatch.setenv("FED_TGAN_TPU_DECODE", "packed16")
     decode_fn, _ = select_snapshot_decode(tf.columns)
     parts = jax.tree.map(np.asarray, jax.jit(decode_fn)(enc))
-    assert parts["u"].dtype == np.int8
+    assert parts["u"].dtype == np.int16
 
     monkeypatch.setenv("FED_TGAN_TPU_DECODE", "packed99")
     import pytest
