@@ -139,6 +139,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the f32 on-device decode; packed16 = 1e-4-of-"
                         "sigma quantization. Equivalent to "
                         "FED_TGAN_TPU_DECODE")
+    p.add_argument("--snapshot-format", choices=["csv", "feather", "parquet"],
+                   default=None,
+                   help="snapshot file format (default csv — the reference "
+                        "protocol its offline eval scripts consume); "
+                        "feather/parquet write typed columns with no value "
+                        "formatting (fastest on a 1-core host).  Equivalent "
+                        "to FED_TGAN_TPU_SNAPSHOT_FORMAT")
     p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler (TensorBoard) trace of the "
                         "LAST --profile-rounds training rounds into DIR — "
@@ -477,6 +484,8 @@ def main(argv=None) -> int:
         # ops.decode.select_snapshot_decode; a flag beats an env var for
         # discoverability, the env var stays for programmatic use
         os.environ["FED_TGAN_TPU_DECODE"] = args.decode
+    if args.snapshot_format:
+        os.environ["FED_TGAN_TPU_SNAPSHOT_FORMAT"] = args.snapshot_format
 
     if args.sample_from:
         rc = _select_backend(args)

@@ -424,6 +424,10 @@ class FederatedTrainer(RoundBookkeeping):
         decode_fn, self._assemble = select_snapshot_decode(
             init.transformers[0].columns
         )
+        # plain-numpy denorm tables of the quantized wire layouts (None on
+        # exact) — SnapshotWriter builds its quantization-aware CSV
+        # formatter from these (data/fastcsv.py)
+        self.snapshot_tables = getattr(decode_fn, "tables", None)
         self._decoded_cache = SampleProgramCache(
             self.spec, self.cfg, decode_fn=decode_fn,
         )
@@ -624,8 +628,15 @@ class FederatedTrainer(RoundBookkeeping):
         and host decode overlap the next round's training (the sampled
         params are immutable device arrays, so the trajectory is
         untouched)."""
+        finish = self.sample_async_parts(n, seed)
+        return lambda: self._assemble(finish())
+
+    def sample_async_parts(self, n: int, seed: int = 0):
+        """Like ``sample_async`` but the finisher returns the RAW packed
+        parts (u/k/disc blocks) without assembling the float matrix — the
+        quantization-aware snapshot formatter consumes these directly
+        (``snapshot_tables`` carries the matching denorm tables)."""
         params_g, state_g = self._global_model()
-        finish = self._decoded_cache.sample_async(
+        return self._decoded_cache.sample_async(
             params_g, state_g, self.server_cond, n, jax.random.key(seed + 29)
         )
-        return lambda: self._assemble(finish())

@@ -88,6 +88,41 @@ class AsyncWorker:
             print(f"WARNING: async worker failed during unwind: {e!r}")
 
 
+class _PackedResult:
+    """A written snapshot in raw packed form — carried to ``drain`` so the
+    (single) final DataFrame conversion happens once, not per snapshot."""
+
+    def __init__(self, parts, assemble):
+        self.parts, self.assemble = parts, assemble
+
+
+def _write_columnar(data, meta, encoders, path: str, fmt: str):
+    """Write a decoded snapshot as feather/parquet (typed columns, no value
+    formatting at all — the write is memcpy-level).  Opt-in via
+    FED_TGAN_TPU_SNAPSHOT_FORMAT / --snapshot-format; the reference's
+    offline eval tooling reads CSVs, so CSV stays the default."""
+    import pyarrow as pa
+
+    from fed_tgan_tpu.data.decode import decode_matrix, decode_to_table
+
+    table = decode_to_table(data, meta, encoders)
+    out = table
+    if table is None:  # dates / missing sentinels: exact pandas path
+        out = decode_matrix(data, meta, encoders)
+        table = pa.Table.from_pandas(out, preserve_index=False)
+    if fmt == "feather":
+        # feather V2 == the Arrow IPC file format (write_feather itself is
+        # deprecated in favor of this); pd.read_feather reads it back
+        with pa.OSFile(path, "wb") as sink, \
+                pa.ipc.new_file(sink, table.schema) as writer:
+            writer.write_table(table)
+    else:
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, path)
+    return out
+
+
 class SnapshotWriter(AsyncWorker):
     """``sample_hook``-compatible callable that writes snapshot CSVs off the
     training thread.
@@ -108,15 +143,46 @@ class SnapshotWriter(AsyncWorker):
     """
 
     def __init__(self, meta, encoders, path_fn: Callable[[int], str],
-                 rows: int = 40000, seed: int = 0, max_pending: int = 2):
+                 rows: int = 40000, seed: int = 0, max_pending: int = 2,
+                 fmt: str | None = None):
         super().__init__(max_pending=max_pending)
         self.meta = meta
         self.encoders = encoders
         self.path_fn = path_fn
         self.rows = rows
         self.seed = seed
+        # snapshot file format: csv (the reference protocol — its offline
+        # eval scripts consume CSVs) or the opt-in columnar formats, whose
+        # writes are memcpy-level (no value formatting at all)
+        self.fmt = fmt or os.environ.get("FED_TGAN_TPU_SNAPSHOT_FORMAT", "csv")
+        if self.fmt not in ("csv", "feather", "parquet"):
+            raise ValueError(
+                f"snapshot format {self.fmt!r}: expected csv, feather or "
+                "parquet (FED_TGAN_TPU_SNAPSHOT_FORMAT)")
+        self._packed = None  # (formatter, assemble) once built; False = N/A
 
     _pre: tuple | None = None
+
+    def _packed_state(self, trainer):
+        """(formatter, assemble) for the quantization-aware path, or None.
+        Built once per writer from the trainer's denorm tables; False is
+        cached when the trainer/layout/meta is ineligible so the probe
+        doesn't rerun every round."""
+        if self._packed is None:
+            tables = getattr(trainer, "snapshot_tables", None)
+            fmtr = None
+            if tables is not None and hasattr(trainer, "sample_async_parts"):
+                from fed_tgan_tpu.data.fastcsv import PackedSnapshotFormatter
+
+                fmtr = PackedSnapshotFormatter.build(
+                    tables, self.meta, self.encoders)
+            if fmtr is None:
+                self._packed = False
+            else:
+                from fed_tgan_tpu.ops.decode import make_assemble_packed_q
+
+                self._packed = (fmtr, make_assemble_packed_q(tables))
+        return self._packed or None
 
     def discard_predispatch(self) -> None:
         """Drop an unconsumed stash.  Called by the trainers' failed-sync
@@ -127,15 +193,36 @@ class SnapshotWriter(AsyncWorker):
 
     def drain(self):
         """Settle all writes; return the LAST snapshot decoded, as the
-        DataFrame contract promises (the fast path hands tables around
-        internally — densified here, once, not per snapshot)."""
+        DataFrame contract promises (the fast paths hand tables / packed
+        parts around internally — densified here, once, not per snapshot)."""
         self.discard_predispatch()
         last = super().drain()
         if last is None:
             return None
+        if isinstance(last, _PackedResult):
+            from fed_tgan_tpu.data.decode import decode_matrix
+
+            return decode_matrix(
+                last.assemble(last.parts), self.meta, self.encoders)
         import pandas as pd
 
         return last if isinstance(last, pd.DataFrame) else table_to_frame(last)
+
+    def _dispatch(self, epoch: int, trainer):
+        """Start this epoch's generation; return (finisher, is_parts).
+        ``is_parts``: the finisher yields raw packed u/k/disc blocks for the
+        quantization-aware formatter instead of an assembled matrix."""
+        if self._use_async(trainer):
+            # the string-LUT formatter only pays off for CSV; columnar
+            # formats write typed columns from the assembled matrix
+            if self.fmt == "csv" and self._packed_state(trainer) is not None:
+                return (trainer.sample_async_parts(
+                    self.rows, seed=self.seed + epoch), True)
+            return (trainer.sample_async(
+                self.rows, seed=self.seed + epoch), False)
+        # no async path / huge request: sample now, write async
+        decoded = trainer.sample(self.rows, seed=self.seed + epoch)
+        return ((lambda: decoded), False)
 
     def predispatch(self, epoch: int, trainer) -> None:
         """Dispatch this epoch's generation program NOW, ahead of the
@@ -151,25 +238,20 @@ class SnapshotWriter(AsyncWorker):
         self._pre = None  # a stale stash must never survive a new dispatch
         self.throttle()  # same bound: at most max_pending snapshots live
         if self._use_async(trainer):
-            self._pre = (epoch,
-                         trainer.sample_async(self.rows, seed=self.seed + epoch))
+            self._pre = (epoch, *self._dispatch(epoch, trainer))
 
     def __call__(self, epoch: int, trainer) -> None:
         if self._pre is not None and self._pre[0] == epoch:
-            finish = self._pre[1]
+            _, finish, is_parts = self._pre
             self._pre = None
-            self.submit(self._finish, epoch, finish)
+            self.submit(self._finish, epoch, finish, is_parts)
             return
         self._pre = None  # stale predispatch for another epoch: drop it
         # throttle BEFORE dispatching, so at most max_pending snapshots'
         # device buffers are ever live
         self.throttle()
-        if self._use_async(trainer):
-            finish = trainer.sample_async(self.rows, seed=self.seed + epoch)
-        else:  # no async path / huge request: sample now, write async
-            decoded = trainer.sample(self.rows, seed=self.seed + epoch)
-            finish = lambda: decoded  # noqa: E731
-        self.submit(self._finish, epoch, finish)
+        finish, is_parts = self._dispatch(epoch, trainer)
+        self.submit(self._finish, epoch, finish, is_parts)
 
     def _use_async(self, trainer) -> bool:
         """Async dispatch keeps every generation chunk's result buffer live
@@ -183,14 +265,32 @@ class SnapshotWriter(AsyncWorker):
             and trainer.fits_async(self.rows)
         )
 
-    def _finish(self, epoch: int, finish):
-        # arrow-direct fast path inside: dictionary-encoded categoricals
-        # (built from the integer codes already in hand) skip the 40k-row
-        # Python-string materialization and the pandas->arrow conversion —
-        # ~2x less worker CPU per snapshot; dates / missing sentinels take
-        # the exact pandas path
-        return decode_and_write_csv(
-            finish(), self.meta, self.encoders, self.path_fn(epoch))
+    def _finish(self, epoch: int, finish, is_parts: bool = False):
+        path = self.path_fn(epoch)
+        if self.fmt != "csv":
+            path = os.path.splitext(path)[0] + "." + self.fmt
+        if is_parts:
+            fmtr, assemble = self._packed  # set before this task's dispatch
+            parts = finish()
+            if self.fmt == "csv":
+                # quantization-aware path: every column is a dictionary of
+                # PRE-FORMATTED strings (built once per run), so the write
+                # is index arithmetic + arrow take + IO — no per-row float
+                # formatting, string materialization or pandas frame
+                from fed_tgan_tpu.data.csvio import write_table_csv
+
+                write_table_csv(fmtr.table(parts), path)
+                return _PackedResult(parts, assemble)
+            data = assemble(parts)
+        else:
+            data = finish()
+        if self.fmt == "csv":
+            # arrow-direct fast path inside: dictionary-encoded categoricals
+            # (built from the integer codes already in hand) skip the
+            # 40k-row Python-string materialization; dates / missing
+            # sentinels take the exact pandas path
+            return decode_and_write_csv(data, self.meta, self.encoders, path)
+        return _write_columnar(data, self.meta, self.encoders, path, self.fmt)
 
 
 def result_path_fn(out_dir: str, name: str) -> Callable[[int], str]:

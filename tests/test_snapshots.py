@@ -75,6 +75,100 @@ def test_snapshot_writer_large_request_uses_bounded_path(trainer):
     assert not small._use_async(Opaque())
 
 
+def test_packed_formatter_csv_value_parity(trainer, tmp_path):
+    """The quantization-aware formatter (string dictionaries precomputed
+    once per run) must parse to the EXACT same values as the assemble +
+    decode_to_table + write_table_csv path it replaces.  (Bytes differ only
+    in quoting: pyarrow quotes string-typed columns, so the pre-formatted
+    continuous values ship quoted — pd.read_csv, what the eval suite and
+    the reference's own offline scripts use, strips them.)"""
+    import pandas as pd
+
+    from fed_tgan_tpu.data.csvio import write_table_csv
+    from fed_tgan_tpu.data.decode import decode_to_table
+    from fed_tgan_tpu.data.fastcsv import PackedSnapshotFormatter
+    from fed_tgan_tpu.ops.decode import make_assemble_packed_q
+
+    init = trainer.init
+    assert trainer.snapshot_tables is not None  # packed8 default
+    fmtr = PackedSnapshotFormatter.build(
+        trainer.snapshot_tables, init.global_meta, init.encoders)
+    assert fmtr is not None
+    parts = trainer.sample_async_parts(120, seed=3)()
+    p_fast = str(tmp_path / "fast.csv")
+    write_table_csv(fmtr.table(parts), p_fast)
+
+    assemble = make_assemble_packed_q(trainer.snapshot_tables)
+    mat = assemble(parts)
+    table = decode_to_table(mat, init.global_meta, init.encoders)
+    p_ref = str(tmp_path / "ref.csv")
+    write_table_csv(table, p_ref)
+    pd.testing.assert_frame_equal(pd.read_csv(p_fast), pd.read_csv(p_ref))
+
+
+def test_packed_formatter_ineligible_cases(trainer):
+    """packed16's 65k levels, exact layout (no tables) and dated metas punt
+    to the existing paths."""
+    import copy
+
+    from fed_tgan_tpu.data.fastcsv import PackedSnapshotFormatter
+
+    init = trainer.init
+    assert PackedSnapshotFormatter.build(
+        None, init.global_meta, init.encoders) is None
+    big = dict(trainer.snapshot_tables, u_scale=32767)
+    assert PackedSnapshotFormatter.build(
+        big, init.global_meta, init.encoders) is None
+    dated = copy.deepcopy(init.global_meta)
+    dated.date_info = {"score": "yymmdd|YYYY-MM-DD"}
+    assert PackedSnapshotFormatter.build(
+        trainer.snapshot_tables, dated, init.encoders) is None
+
+    # a mode that can emit the missing-continuous sentinel punts too (the
+    # exact paths map it to the blank token; the LUT must not write it as
+    # a number)
+    import numpy as np
+
+    from fed_tgan_tpu.data.constants import MISSING_CONTINUOUS
+
+    poisoned = dict(trainer.snapshot_tables)
+    mu = np.array(poisoned["mu"], dtype=np.float64, copy=True)
+    sg = np.array(poisoned["sg"], dtype=np.float64, copy=True)
+    mu[0, 0], sg[0, 0] = MISSING_CONTINUOUS, 0.0
+    poisoned["mu"], poisoned["sg"] = mu, sg
+    assert PackedSnapshotFormatter.build(
+        poisoned, init.global_meta, init.encoders) is None
+
+
+def test_snapshot_writer_columnar_formats(trainer, tmp_path):
+    """feather/parquet opt-in: typed columns, readable back to the same
+    values as the CSV; the extension swaps; bad formats are rejected."""
+    import pandas as pd
+    import pytest
+
+    init = trainer.init
+    for fmt, reader in (("feather", pd.read_feather),
+                        ("parquet", pd.read_parquet)):
+        path_fn = result_path_fn(str(tmp_path / fmt), "toy")
+        with SnapshotWriter(init.global_meta, init.encoders, path_fn,
+                            rows=64, fmt=fmt) as writer:
+            trainer.fit(1, sample_hook=writer)
+            last = writer.drain()
+        e = trainer.completed_epochs - 1
+        out = path_fn(e)[: -len(".csv")] + f".{fmt}"
+        assert os.path.exists(out)
+        got = reader(out)
+        # dictionary columns come back as pandas Categorical; compare values
+        for c in got.columns:
+            if str(got[c].dtype) == "category":
+                got[c] = got[c].astype(object)
+        pd.testing.assert_frame_equal(got, last.reset_index(drop=True),
+                                      check_dtype=False)
+
+    with pytest.raises(ValueError, match="snapshot format"):
+        SnapshotWriter(init.global_meta, init.encoders, str, fmt="xlsx")
+
+
 def test_snapshot_writer_error_propagates(trainer, tmp_path):
     init = trainer.init
     writer = SnapshotWriter(
