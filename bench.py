@@ -38,6 +38,20 @@ CSV_PATH = os.environ.get(
 )
 
 
+# (utc, ok, reason) of every accelerator probe this invocation ran —
+# attached to no-chip-number records so BENCH_r*.json shows the probes
+# spanning the session instead of a single burned-at-startup burst
+PROBE_HISTORY: list = []
+
+
+def _note_probe(ok: bool, reason: str) -> None:
+    PROBE_HISTORY.append({
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "ok": bool(ok),
+        "reason": str(reason or "")[-200:],
+    })
+
+
 def _ensure_responsive_backend() -> str:
     """Probe the accelerator (shared helper); fall back to CPU if wedged.
 
@@ -46,12 +60,12 @@ def _ensure_responsive_backend() -> str:
     nothing; a CPU-fallback run records a clearly-labeled number instead.
     Returns "" (accelerator fine) or "(cpu-fallback)" to tag the metric.
 
-    This is the one command whose entire purpose is the accelerator
-    number, so a single failed probe must not flip the run to CPU: the
-    probe retries with backoff (~8 min worst case, narrated on stderr)
-    before giving up, and a successful probe is immediately followed by a
-    watchdog-guarded in-process backend touch so a wedge arriving inside
-    the probe cache window aborts loudly instead of hanging the bench.
+    Probe budget is SPREAD across the run, not burned at startup (VERDICT
+    r04): two quick attempts here (~1 min of backoff), then the CPU
+    fallback proceeds and ``_retry_on_chip`` re-probes AFTER it finishes —
+    if the tunnel healed during the fallback run, the workload re-runs on
+    the chip and the chip number replaces the fallback line.  Every probe
+    lands in PROBE_HISTORY, which rides the JSON record.
     """
     from fed_tgan_tpu.parallel.mesh import (
         probe_backend_responsive,
@@ -59,11 +73,11 @@ def _ensure_responsive_backend() -> str:
     )
 
     try:
-        attempts = int(os.environ.get("FED_TGAN_BENCH_PROBE_ATTEMPTS", "3"))
+        attempts = int(os.environ.get("FED_TGAN_BENCH_PROBE_ATTEMPTS", "2"))
     except ValueError:
         print("bench: ignoring non-integer FED_TGAN_BENCH_PROBE_ATTEMPTS",
               file=sys.stderr)
-        attempts = 3
+        attempts = 2
     ok, reason = probe_backend_responsive(
         attempts=attempts,
         backoff_s=60.0,
@@ -73,14 +87,75 @@ def _ensure_responsive_backend() -> str:
         # hang -> watchdog aborts with diagnosis; crash -> CPU fallback
         ok, reason = touch_backend_with_watchdog(timeout_s=180.0, who="bench: ")
         if ok:
+            _note_probe(True, "healthy at startup")
             return ""
+    _note_probe(False, reason)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     print(f"WARNING: accelerator backend unusable ({reason}); "
-          "benchmarking on CPU.  Diagnose the stack with "
-          "`python -m fed_tgan_tpu.doctor`", file=sys.stderr)
+          "benchmarking on CPU, then re-probing for a chip re-run.  "
+          "Diagnose the stack with `python -m fed_tgan_tpu.doctor`",
+          file=sys.stderr)
     return "(cpu-fallback)"
+
+
+def _retry_on_chip(workload: str) -> dict | None:
+    """After a CPU-fallback run finishes, re-probe the accelerator; if the
+    tunnel healed mid-session, re-run this exact bench invocation on the
+    chip in a SUBPROCESS (this process's jax is pinned to cpu by the
+    fallback) and return its clean record.
+
+    Returns None when the tunnel is still wedged, the child could not
+    measure the chip either (its line carries a fallback/wedge tag), or
+    its output is unparseable — the caller then keeps the CPU line, now
+    annotated with the full probe history.
+    """
+    if os.environ.get("FED_TGAN_BENCH_NO_RETRY", "") == "1":
+        return None  # the chip re-run itself must not recurse
+    import subprocess
+
+    from fed_tgan_tpu.parallel.mesh import probe_backend_responsive
+
+    print("bench: cpu-fallback run done; re-probing the accelerator for a "
+          "chip re-run", file=sys.stderr, flush=True)
+    ok, reason = probe_backend_responsive(
+        attempts=1, timeout_s=300, ignore_cache=True,
+        log=lambda msg: print(f"bench: {msg}", file=sys.stderr, flush=True),
+    )
+    _note_probe(ok, reason if not ok else "healed after fallback run")
+    if not ok:
+        return None
+    env = dict(os.environ)
+    env["FED_TGAN_BENCH_NO_RETRY"] = "1"
+    env["FED_TGAN_BENCH_PROBE_ATTEMPTS"] = "1"
+    print("bench: tunnel healed — re-running the workload on the chip",
+          file=sys.stderr, flush=True)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    line = ""
+    for cand in reversed(proc.stdout.strip().splitlines()):
+        if cand.startswith("{"):
+            line = cand
+            break
+    if not line:
+        print("bench: chip re-run produced no JSON line; keeping the "
+              f"cpu-fallback record\n{proc.stderr[-2000:]}",
+              file=sys.stderr, flush=True)
+        return None
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    metric = str(rec.get("metric", ""))
+    if "cpu-fallback" in metric or "wedged" in metric:
+        _note_probe(False, f"chip re-run also failed: {metric}")
+        return None
+    rec["recovered_after_cpu_fallback"] = True
+    return rec
 
 
 # Evidence older than this is not attached at all.  72 h spans a round
@@ -195,6 +270,7 @@ def _arm_run_deadline(workload: str, tag: str, epochs: int = 500,
                     f"{deadline_min:.1f} min) — backend likely wedged "
                     "mid-measurement; no perf claim",
             "vs_baseline": 0,
+            "probe_history": PROBE_HISTORY,
         }
         # the mid-run wedge is the main case the prior-capture evidence
         # exists for (BENCH_r02 lost the round's number exactly this way)
@@ -1180,6 +1256,7 @@ def main() -> int:
             "unit": f"backend UNAVAILABLE mid-run ({type(exc).__name__}); "
                     "no perf claim",
             "vs_baseline": 0,
+            "probe_history": PROBE_HISTORY,
         }
         _attach_tpu_evidence(rec, "(wedged-fast-fail)")
         print(json.dumps(rec))
@@ -1188,6 +1265,18 @@ def main() -> int:
     if bgm != "sklearn":
         out["metric"] += f"({bgm}-bgm)"
     out["metric"] += tag
+    if tag == "(cpu-fallback)":
+        # spread-probe policy, second half: the tunnel may have healed
+        # while the fallback ran — re-probe and re-run on the chip, so the
+        # driver artifact is a same-session TPU number whenever one was
+        # measurable at ANY point in the session
+        rec = _retry_on_chip(args.workload)
+        if rec is not None:
+            rec["cpu_fallback_record"] = out  # the superseded CPU number
+            rec["probe_history"] = PROBE_HISTORY
+            print(json.dumps(rec))
+            return 0
+        out["probe_history"] = PROBE_HISTORY
     _attach_tpu_evidence(out, tag)
     print(json.dumps(out))
     return 0

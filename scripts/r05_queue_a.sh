@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-5 ablation queue A: the IID 8-client delta-F1 anchor (VERDICT
+# missing #3 / next-round #1).  Same full500 shape as BASELINE configs 2/3
+# (8 clients, 500 epochs) but through the utility workload so the row
+# carries delta-F1 next to Avg_JSD/Avg_WD.  Three seeds for a spread.
+set -u
+cd /root/repo
+OUT=NONIID_SWEEP_r05.jsonl
+for seed in 0 1 2; do
+  args=(--workload utility --clients 8 --backend cpu)
+  [ "$seed" != 0 ] && args+=(--gan-seed "$seed")
+  echo "[queueA $(date -u +%H:%M:%S)] starting iid8 seed=$seed" >> r05_queue_a.log
+  line=$(/opt/venv/bin/python bench.py "${args[@]}" 2>>r05_queue_a.log | tail -1)
+  if [ -n "$line" ]; then
+    echo "$line" >> "$OUT"
+    echo "[queueA $(date -u +%H:%M:%S)] done seed=$seed: $line" >> r05_queue_a.log
+  else
+    echo "[queueA $(date -u +%H:%M:%S)] FAILED seed=$seed (no JSON line)" >> r05_queue_a.log
+  fi
+done
+echo "[queueA $(date -u +%H:%M:%S)] queue A complete" >> r05_queue_a.log

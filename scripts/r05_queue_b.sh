@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-5 ablation queue B: scarcity-vs-aggregation attribution (VERDICT
+# next-round #1).  Queue A measured the IID 8-client anchor collapsing to
+# dF1 0.42-0.58 — as bad as the dirichlet rows — pointing at the per-client
+# step budget (884 rows -> 1 step/round at batch 500), not aggregation.
+# These runs test that hypothesis:
+#   b1: IID 8-client, batch 100 -> 8 steps/client/round at the same
+#       500-epoch horizon (step budget restored, client count fixed)
+#   b2: 2-client, train_rows 1768 -> same per-client scarcity as the
+#       8-client runs with 2-way aggregation (client-count control)
+#   b3: IID 8-client, epochs 3500 -> step budget matched by horizon
+#   b4: dirichlet a0.5 8-client, batch 100 -> the same correction under
+#       skew: does non-IID still collapse once the budget is restored?
+set -u
+cd /root/repo
+OUT=NONIID_SWEEP_r05.jsonl
+run_one() {
+  local label="$1"; shift
+  echo "[queueB $(date -u +%H:%M:%S)] starting $label" >> r05_queue_b.log
+  local line
+  line=$(/opt/venv/bin/python bench.py "$@" 2>>r05_queue_b.log | tail -1)
+  if [ -n "$line" ]; then
+    echo "$line" >> "$OUT"
+    echo "[queueB $(date -u +%H:%M:%S)] done $label: $line" >> r05_queue_b.log
+  else
+    echo "[queueB $(date -u +%H:%M:%S)] FAILED $label (no JSON line; see stderr above)" >> r05_queue_b.log
+  fi
+}
+run_one b1-iid8-batch100 --workload utility --clients 8 --batch-size 100 --backend cpu
+run_one b2-2client-rows1768 --workload utility --train-rows 1768 --backend cpu
+run_one b3-iid8-3500ep --workload utility --clients 8 --epochs 3500 --backend cpu
+run_one b4-dir05-batch100 --workload utility --clients 8 --batch-size 100 \
+  --shard-strategy dirichlet --alpha 0.5 --backend cpu
+echo "[queueB $(date -u +%H:%M:%S)] queue B complete" >> r05_queue_b.log
