@@ -100,11 +100,19 @@ def _ensure_responsive_backend() -> str:
     return "(cpu-fallback)"
 
 
-def _retry_on_chip(workload: str) -> dict | None:
+def _retry_on_chip(deadline_min: float) -> dict | None:
     """After a CPU-fallback run finishes, re-probe the accelerator; if the
     tunnel healed mid-session, re-run this exact bench invocation on the
     chip in a SUBPROCESS (this process's jax is pinned to cpu by the
     fallback) and return its clean record.
+
+    ``deadline_min`` is the parent run's mid-run deadline: the child arms
+    the same internal watchdog, but if the tunnel wedges the child inside an
+    uninterruptible C call BEFORE the watchdog thread is armed (or the
+    watchdog itself is starved), ``subprocess.run`` would block forever and
+    take the parent's already-measured CPU record with it — so the wait
+    carries a hard ``deadline + margin`` timeout and a ``TimeoutExpired``
+    child is treated as still-wedged.
 
     Returns None when the tunnel is still wedged, the child could not
     measure the chip either (its line carries a fallback/wedge tag), or
@@ -131,11 +139,22 @@ def _retry_on_chip(workload: str) -> dict | None:
     env["FED_TGAN_BENCH_PROBE_ATTEMPTS"] = "1"
     print("bench: tunnel healed — re-running the workload on the chip",
           file=sys.stderr, flush=True)
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
-        capture_output=True, text=True, env=env,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
+    # 5 min margin past the child's own deadline: probe + init + the
+    # child's deadline-fired JSON emission all fit well inside it
+    budget_s = max(60.0, deadline_min * 60.0) + 300.0
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=budget_s,
+        )
+    except subprocess.TimeoutExpired:
+        _note_probe(False, f"chip re-run exceeded {budget_s:.0f}s; "
+                           "still wedged")
+        print(f"bench: chip re-run did not finish within {budget_s:.0f}s; "
+              "keeping the cpu-fallback record", file=sys.stderr, flush=True)
+        return None
     line = ""
     for cand in reversed(proc.stdout.strip().splitlines()):
         if cand.startswith("{"):
@@ -1270,7 +1289,8 @@ def main() -> int:
         # while the fallback ran — re-probe and re-run on the chip, so the
         # driver artifact is a same-session TPU number whenever one was
         # measurable at ANY point in the session
-        rec = _retry_on_chip(args.workload)
+        rec = _retry_on_chip(
+            _deadline_minutes(epochs, args.workload, work_scale))
         if rec is not None:
             rec["cpu_fallback_record"] = out  # the superseded CPU number
             rec["probe_history"] = PROBE_HISTORY

@@ -121,6 +121,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint directory (default <out>/checkpoint)")
     p.add_argument("--resume", action="store_true",
                    help="resume from --ckpt-dir; --epochs counts total rounds")
+    p.add_argument("--ckpt-keep", type=int, default=1,
+                   help="checkpoint generations to retain (atomic rotation: "
+                        "<dir>, <dir>.1, ...; default 1). --resume picks the "
+                        "newest VALID generation, so a crash mid-save never "
+                        "loses the run")
+    p.add_argument("--min-clients", type=int, default=None,
+                   help="multihost init: tolerate client dropouts — drop the "
+                        "unreachable rank, renormalize the similarity "
+                        "weights over the survivors, continue while at "
+                        "least this many clients remain (default: every "
+                        "client required; any dropout aborts cleanly)")
+    p.add_argument("--faults", type=str, default=None, metavar="SPEC",
+                   help="deterministic fault-injection plan for testing "
+                        "the fault-tolerance paths, e.g. "
+                        "'kill_client:rank=3,round=2;delay_msg:ms=50' "
+                        "(equivalent to FED_TGAN_TPU_FAULTS; see "
+                        "fed_tgan_tpu.testing.faults)")
     p.add_argument("--save-model", action="store_true",
                    help="persist the sampling artifact to <out>/models/synthesizer")
     p.add_argument("--sample-from", type=str, default=None, metavar="DIR",
@@ -261,6 +278,7 @@ def _run_multihost_init(args) -> int:
             save_every=args.save_every,
             ckpt_dir=args.ckpt_dir or os.path.join(args.out_dir, "checkpoint"),
             resume=args.resume,
+            snapshot_format=args.snapshot_format or "csv",
         )
 
     if args.rank == 0:
@@ -269,6 +287,7 @@ def _run_multihost_init(args) -> int:
             out = server_initialize(
                 t, seed=args.seed, weighted=not args.uniform,
                 backend=args.bgm_backend, run_name=name,
+                min_clients=args.min_clients,
             )
             out["global_meta"].dump_json(
                 os.path.join(args.out_dir, "models", f"{name}.json")
@@ -486,6 +505,13 @@ def main(argv=None) -> int:
         os.environ["FED_TGAN_TPU_DECODE"] = args.decode
     if args.snapshot_format:
         os.environ["FED_TGAN_TPU_SNAPSHOT_FORMAT"] = args.snapshot_format
+    if args.faults:
+        from fed_tgan_tpu.testing.faults import FaultPlan, install_plan
+
+        # install in-process AND export, so multihost rank subprocesses and
+        # respawned workers see the same plan
+        install_plan(FaultPlan.parse(args.faults))
+        os.environ["FED_TGAN_TPU_FAULTS"] = args.faults
 
     if args.sample_from:
         rc = _select_backend(args)
@@ -538,9 +564,13 @@ def main(argv=None) -> int:
 
     ckpt_dir = args.ckpt_dir or os.path.join(args.out_dir, "checkpoint")
     if args.resume:
-        from fed_tgan_tpu.runtime.checkpoint import load_federated
+        from fed_tgan_tpu.runtime.checkpoint import find_resumable, load_federated
 
-        trainer = load_federated(ckpt_dir)
+        # auto-resume: newest VALID generation wins, so a crash mid-save
+        # (partial primary dir) falls back to the previous rotation instead
+        # of dying on a corrupt checkpoint
+        ckpt_src = find_resumable(ckpt_dir) or ckpt_dir
+        trainer = load_federated(ckpt_src)
         init = trainer.init
         # the checkpointed run identity wins over re-derived CLI defaults so
         # output paths stay stable even when flags aren't re-passed
@@ -558,7 +588,7 @@ def main(argv=None) -> int:
                 print(f"--eval skipped: cannot reload training data ({exc}); "
                       "pass --datapath/--client-data to evaluate a resumed run")
         if not args.quiet:
-            print(f"resumed from {ckpt_dir} at round {trainer.completed_epochs}")
+            print(f"resumed from {ckpt_src} at round {trainer.completed_epochs}")
         return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
 
     t_init = time.time()
@@ -622,7 +652,8 @@ def main(argv=None) -> int:
 
         trainer = MDGANTrainer(init, config=cfg, seed=args.seed)
     else:
-        trainer = FederatedTrainer(init, config=cfg, seed=args.seed)
+        trainer = FederatedTrainer(init, config=cfg, seed=args.seed,
+                                   min_clients=args.min_clients or 1)
     return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
 
 
@@ -858,7 +889,7 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
         if save_due(e):
             from fed_tgan_tpu.runtime.checkpoint import save_federated
 
-            save_federated(tr, ckpt_dir, run_name=name)
+            save_federated(tr, ckpt_dir, run_name=name, keep=args.ckpt_keep)
 
     def _hook_predispatch(e, tr):
         # forward the trainer's pre-sync predispatch (train -> sample with
@@ -911,7 +942,7 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     if args.save_every and trainer.completed_epochs % args.save_every != 0:
         from fed_tgan_tpu.runtime.checkpoint import save_federated
 
-        save_federated(trainer, ckpt_dir, run_name=name)
+        save_federated(trainer, ckpt_dir, run_name=name, keep=args.ckpt_keep)
     if args.save_model:
         from fed_tgan_tpu.runtime.checkpoint import save_synthesizer
 

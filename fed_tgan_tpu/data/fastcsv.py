@@ -81,7 +81,16 @@ class PackedSnapshotFormatter:
             return None
         try:
             import pyarrow as pa
+            import pyarrow.csv as pacsv
         except ImportError:
+            return None
+        try:
+            # float byte-parity depends on "needed" quoting (csvio's writer
+            # silently falls back to quote-everything on old pyarrow, which
+            # would wrap every continuous value in quotes) — so the fast
+            # path is only eligible when the option exists
+            pacsv.WriteOptions(quoting_style="needed")
+        except (TypeError, ValueError):
             return None
         u_scale = int(tables["u_scale"])
         levels = 2 * u_scale + 1

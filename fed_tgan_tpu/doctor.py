@@ -75,12 +75,12 @@ def check_virtual_mesh(n: int = 2) -> bool:
     import subprocess
 
     code = (
-        "from fed_tgan_tpu.parallel.mesh import provision_virtual_cpu, client_mesh\n"
+        "from fed_tgan_tpu.parallel.mesh import provision_virtual_cpu, client_mesh, shard_map\n"
         f"provision_virtual_cpu({n})\n"
         "import jax, jax.numpy as jnp\n"
         "from jax.sharding import PartitionSpec as P\n"
         f"mesh = client_mesh({n})\n"
-        "out = jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, 'clients'),\n"
+        "out = jax.jit(shard_map(lambda x: jax.lax.psum(x, 'clients'),\n"
         "    mesh=mesh, in_specs=P('clients'), out_specs=P()))(\n"
         f"    jnp.arange({n}, dtype=jnp.float32))\n"
         f"assert float(out[0]) == sum(range({n})), out\n"
@@ -122,6 +122,12 @@ def check_transport() -> bool:
             with ClientTransport("127.0.0.1", port, 1, timeout_ms=10_000) as c:
                 c.send_obj({"ping": 1})
                 result["echo"] = c.recv_obj()
+                # sever our own socket, then send again: the transport must
+                # reconnect with backoff and resync sequence numbers — the
+                # fault-tolerance path a flaky link exercises in production
+                c._lib.ft_peer_close(c._handle, 0)
+                c.send_obj({"ping": 2})
+                result["echo2"] = c.recv_obj()
         except Exception as exc:  # surfaced via the missing echo below
             result["err"] = repr(exc)
 
@@ -131,14 +137,19 @@ def check_transport() -> bool:
         with ServerTransport(port, 1, timeout_ms=10_000) as server:
             got = server.recv_obj(1)
             server.send_obj(1, got)
+            got = server.recv_obj(1)  # arrives over the reconnected socket
+            server.send_obj(1, got)
     except Exception as exc:
         return _line(False, "transport", f"{exc!r}")
     t.join(timeout=10)
     if result.get("echo") != {"ping": 1}:
         return _line(False, "transport",
                      result.get("err", "echo mismatch or client timeout"))
+    if result.get("echo2") != {"ping": 2}:
+        return _line(False, "transport",
+                     result.get("err", "reconnect echo mismatch or timeout"))
     return _line(True, "transport",
-                 f"C++ loopback roundtrip ok (port {port})")
+                 f"C++ loopback roundtrip + sever/reconnect ok (port {port})")
 
 
 def check_compile_cache() -> bool:

@@ -36,44 +36,119 @@ from fed_tgan_tpu.federation.init import (
 from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
 
 
+def _check_floor(
+    transport: ServerTransport, phase: str, min_clients: int | None,
+    newly_dropped: list[int],
+) -> None:
+    import logging
+
+    if newly_dropped:
+        logging.getLogger("fed_tgan_tpu.federation").warning(
+            "init %s: dropped client rank(s) %s; continuing with %d survivors",
+            phase, newly_dropped, len(transport.live_ranks()),
+        )
+    floor = transport.n_clients if min_clients is None else min_clients
+    live = len(transport.live_ranks())
+    if live < floor or live == 0:
+        raise RuntimeError(
+            f"aborting during init ({phase}): {live} live clients is below "
+            f"min_clients={floor} (dropped: {sorted(transport.dropped)})"
+        )
+
+
+def _gather_phase(
+    transport: ServerTransport, phase: str, min_clients: int | None
+) -> dict[int, object]:
+    """One fault-tolerant gather: returns ``{rank: payload}`` over the
+    ranks that answered.  With ``min_clients`` set, a missing client is
+    dropped (logged, weights later renormalized over survivors); without
+    it, ANY drop aborts cleanly — the reference's all-or-nothing contract,
+    minus the hang."""
+    results, newly_dropped = transport.gather_surviving()
+    _check_floor(transport, phase, min_clients, newly_dropped)
+    return results
+
+
+def _broadcast_phase(
+    transport: ServerTransport, obj: object, phase: str,
+    min_clients: int | None,
+) -> None:
+    """Fault-tolerant counterpart of :func:`_gather_phase` for the
+    server->clients direction: an unreachable rank is dropped instead of
+    aborting the broadcast, subject to the same survivor floor."""
+    newly_dropped = transport.broadcast_surviving(obj)
+    _check_floor(transport, phase, min_clients, newly_dropped)
+
+
 def server_initialize(
     transport: ServerTransport,
     seed: int = 0,
     weighted: bool = True,
     backend: str = "sklearn",
     run_name: str | None = None,
+    min_clients: int | None = None,
 ) -> dict:
     """Drive the init protocol from rank 0; returns the global artifacts.
 
     ``run_name`` rides along with the harmonized meta so every client labels
     its artifacts consistently with the server's (clients may be launched
-    with differently-named shard CSVs)."""
-    local_metas = transport.gather()
+    with differently-named shard CSVs).
 
-    global_meta_dict, encoders, jsd = harmonize_categories(local_metas)
-    transport.broadcast(
-        {"meta": global_meta_dict, "encoders": encoders, "run_name": run_name}
+    ``min_clients`` enables graceful degradation: a client that misses its
+    deadline or dies mid-protocol is dropped and the similarity weights are
+    computed over the survivors (the paper's weighting restricted to live
+    ranks); the run aborts cleanly if survivors fall below the floor.  With
+    ``min_clients=None`` (default) every client is required — a dropout
+    aborts with a clear error instead of hanging."""
+    metas = _gather_phase(transport, "gather-metas", min_clients)
+    meta_ranks = sorted(metas)
+
+    global_meta_dict, encoders, jsd = harmonize_categories(
+        [metas[r] for r in meta_ranks]
+    )
+    jsd_by_rank = dict(zip(meta_ranks, np.asarray(jsd)))
+    _broadcast_phase(
+        transport,
+        {"meta": global_meta_dict, "encoders": encoders, "run_name": run_name},
+        "broadcast-meta", min_clients,
     )
 
-    infos = transport.gather()  # [{"gmms": [...], "rows": int}]
-    client_gmms = [i["gmms"] for i in infos]
-    rows = [i["rows"] for i in infos]
+    infos = _gather_phase(transport, "gather-gmms", min_clients)
+    info_ranks = sorted(infos)  # [{"gmms": [...], "rows": int}] by rank
+    client_gmms = [infos[r]["gmms"] for r in info_ranks]
+    rows_by_rank = {r: infos[r]["rows"] for r in info_ranks}
 
-    global_gmms, wd = harmonize_continuous(client_gmms, rows, seed=seed, backend=backend)
-    transport.broadcast({"gmms": global_gmms})
+    global_gmms, wd = harmonize_continuous(
+        client_gmms, [rows_by_rank[r] for r in info_ranks], seed=seed,
+        backend=backend,
+    )
+    wd_by_rank = dict(zip(info_ranks, np.asarray(wd)))
+    _broadcast_phase(transport, {"gmms": global_gmms}, "broadcast-gmms",
+                     min_clients)
 
     # pooled conditional-sampling counts: the reference server rebuilds its
     # Cond on the FULL training table (distributed.py:565-580); here the
     # clients exchange additive one-hot counts instead of rows, so the
     # pooled distribution is identical without centralizing any data
-    cond_counts = sum(transport.gather())
+    counts = _gather_phase(transport, "gather-cond-counts", min_clients)
+    cond_counts = sum(counts[r] for r in sorted(counts))
 
+    # the weighting runs over the ranks that survived EVERY phase; a rank
+    # that contributed metas/GMMs but died later is excluded and the
+    # similarity-derived weights renormalize over the survivors
+    final_ranks = [r for r in transport.live_ranks() if r in wd_by_rank]
+    jsd_live = np.asarray([jsd_by_rank[r] for r in final_ranks])
+    wd_live = np.asarray([wd_by_rank[r] for r in final_ranks])
+    rows = [rows_by_rank[r] for r in final_ranks]
     if weighted:
-        weights = aggregation_weights(jsd, wd, rows)
+        weights = aggregation_weights(jsd_live, wd_live, rows)
     else:
         weights = np.full(len(rows), 1.0 / len(rows))
-    transport.broadcast(
-        {"weights": weights, "rows_per_client": rows, "cond_counts": cond_counts}
+    _broadcast_phase(
+        transport,
+        {"weights": weights, "rows_per_client": rows, "cond_counts": cond_counts,
+         "live_ranks": final_ranks},
+        "broadcast-weights", min_clients,
     )
 
     return {
@@ -81,10 +156,12 @@ def server_initialize(
         "encoders": encoders,
         "global_gmms": global_gmms,
         "weights": weights,
-        "jsd": jsd,
-        "wd": wd,
+        "jsd": jsd_live,
+        "wd": wd_live,
         "rows_per_client": rows,
         "cond_counts": cond_counts,
+        "live_ranks": final_ranks,
+        "dropped": sorted(transport.dropped),
     }
 
 

@@ -201,6 +201,18 @@ def aggregation_weights(
     return e / e.sum()
 
 
+def renormalize_weights(weights: np.ndarray, alive: np.ndarray) -> np.ndarray:
+    """Restrict aggregation weights to the surviving clients and rescale to
+    sum 1 — the paper's similarity weighting over live ranks only.  ``alive``
+    is a boolean mask aligned with ``weights``; dropped clients get exactly
+    0 so their (stale) models contribute nothing to the psum."""
+    w = np.asarray(weights, dtype=np.float64) * np.asarray(alive, dtype=bool)
+    total = w.sum()
+    if total <= 0.0:
+        raise ValueError("no surviving clients: all aggregation weight lost")
+    return (w / total).astype(np.float32)
+
+
 @dataclass
 class FederatedInit:
     """Everything the device-mesh trainer needs after init."""

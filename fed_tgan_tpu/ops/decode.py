@@ -315,11 +315,32 @@ def select_snapshot_decode(columns: Sequence):
     if not mode and os.environ.get("FED_TGAN_TPU_EXACT_DECODE", "") == "1":
         mode = "exact"
     if mode == "exact":
+        _log_decode_layout("exact")
         return make_device_decode_packed(columns)
     if mode in ("", "packed8"):
+        _log_decode_layout("packed8" + (" (default)" if not mode else ""))
         return make_device_decode_packed8(columns)
     if mode == "packed16":
+        _log_decode_layout("packed16")
         return make_device_decode_packed16(columns)
     raise ValueError(
         f"FED_TGAN_TPU_DECODE={mode!r}: expected exact, packed16 or packed8"
     )
+
+
+_decode_layout_logged = False
+
+
+def _log_decode_layout(layout: str) -> None:
+    """One line per process naming the active snapshot decode layout, so a
+    run's logs show which quantization (and therefore which CSV parity
+    contract) its snapshots carry without reverse-engineering env vars."""
+    global _decode_layout_logged
+    if _decode_layout_logged:
+        return
+    _decode_layout_logged = True
+    import logging
+
+    logging.getLogger("fed_tgan_tpu.decode").info(
+        "snapshot decode layout: %s (override with "
+        "FED_TGAN_TPU_DECODE=exact|packed16|packed8)", layout)

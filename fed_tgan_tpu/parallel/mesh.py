@@ -21,6 +21,35 @@ from jax.sharding import Mesh
 CLIENTS_AXIS = "clients"
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes it at the top level with a ``check_vma`` knob; older
+    releases (<= 0.4.x) only have ``jax.experimental.shard_map.shard_map``
+    where the same knob is spelled ``check_rep``.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def pcast_varying(x, axes):
+    """Mark ``x`` as device-varying over ``axes`` where the jax version
+    tracks varying-ness (``jax.lax.pcast``); identity on older releases,
+    which have no vma type system to satisfy."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return x
+
+
 def cpu_pinned() -> bool:
     """Whether this process can only ever see the cpu platform.  The config
     value only reflects ``config.update``; an env-var pin is read by jax at

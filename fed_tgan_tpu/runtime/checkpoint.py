@@ -19,15 +19,26 @@ Format: a directory holding ``host.pkl`` (plain-Python/numpy objects) and
 ``arrays.npz`` (every pytree leaf, keyed by flatten order).  Leaves are
 restored into a freshly-constructed trainer whose pytree *structure* is
 rebuilt from the checkpointed config, so no treedef serialization is needed.
+
+Crash safety: saves are staged in a sibling temp directory (every file
+fsynced, then a ``COMPLETE`` marker, then the directory itself) and
+published with atomic renames, rotating the previous checkpoint to
+``<path>.1`` … ``<path>.K-1`` (``keep`` last-K).  A crash at ANY point
+leaves the newest previously-published checkpoint loadable;
+:func:`find_resumable` picks it up for auto-resume.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import shutil
 
 import jax
 import numpy as np
+
+log = logging.getLogger("fed_tgan_tpu.checkpoint")
 
 FORMAT_VERSION = 2  # v2: optional EMA leaves in federated checkpoints
 
@@ -39,13 +50,116 @@ _V1 = 1
 
 _HOST = "host.pkl"
 _ARRAYS = "arrays.npz"
+_MARKER = "COMPLETE"
+
+
+def _fsync_file(f) -> None:
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _stage_dir(path: str) -> str:
+    """Fresh sibling temp directory the save is staged into."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    base = os.path.basename(path)
+    # sweep stale stages from earlier crashed writers (single-writer layout)
+    for entry in os.listdir(parent):
+        if entry.startswith(f"{base}.tmp-"):
+            shutil.rmtree(os.path.join(parent, entry), ignore_errors=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    os.makedirs(tmp)
+    return tmp
+
+
+def _seal_dir(tmp: str) -> None:
+    """Marker + dir fsync: after this, ``tmp`` is a valid checkpoint."""
+    with open(os.path.join(tmp, _MARKER), "wb") as f:
+        _fsync_file(f)
+    _fsync_dir(tmp)
+
+
+def _publish_dir(tmp: str, path: str, keep: int) -> None:
+    """Atomically publish sealed ``tmp`` as ``path``, rotating the previous
+    checkpoint into ``path.1`` … ``path.{keep-1}`` (oldest falls off)."""
+    keep = max(1, int(keep))
+    doomed = f"{path}.{keep}"
+    if os.path.isdir(doomed):
+        shutil.rmtree(doomed)
+    for i in range(keep - 1, 0, -1):
+        src = f"{path}.{i}"
+        if os.path.isdir(src):
+            os.replace(src, f"{path}.{i + 1}")
+    if os.path.isdir(path):
+        os.replace(path, f"{path}.1")
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    if keep == 1:
+        # the rotation slot was only a publish staging step
+        transient = f"{path}.1"
+        if os.path.isdir(transient):
+            shutil.rmtree(transient)
+
+
+def _is_valid_checkpoint(path: str) -> bool:
+    """Both payload files present and readable.  The ``COMPLETE`` marker is
+    checked when present-able but not required: checkpoints written before
+    the atomic layout lack it yet are fully usable."""
+    try:
+        with open(os.path.join(path, _HOST), "rb") as f:
+            pickle.load(f)
+        with np.load(os.path.join(path, _ARRAYS)) as data:
+            _ = data.files
+        return True
+    except Exception:
+        return False
+
+
+def find_resumable(path: str, max_rotations: int = 8) -> str | None:
+    """Newest valid checkpoint at ``path`` (or its rotation slots
+    ``path.1`` … — a crash can leave the primary slot empty or torn while
+    an older rotation is intact).  None when nothing loadable exists."""
+    candidates = [path] + [f"{path}.{i}" for i in range(1, max_rotations + 1)]
+    for cand in candidates:
+        if os.path.isdir(cand) and _is_valid_checkpoint(cand):
+            if cand != path:
+                log.warning(
+                    "checkpoint: primary %s unusable, resuming from %s",
+                    path, cand,
+                )
+            return cand
+    return None
+
+
+def _fault_hook(path: str) -> None:
+    """Mid-write fault-injection point (no-op unless a plan is active)."""
+    try:
+        from fed_tgan_tpu.testing.faults import active_plan
+    except Exception:
+        return
+    plan = active_plan()
+    if plan is not None:
+        plan.on_checkpoint_write(path)
 
 
 def _save_leaves(tree, extra: dict, path: str) -> None:
     leaves = jax.tree.leaves(tree)
     arrays = {f"leaf_{i:05d}": np.asarray(l) for i, l in enumerate(leaves)}
     arrays.update({k: np.asarray(v) for k, v in extra.items()})
-    np.savez(os.path.join(path, _ARRAYS), **arrays)
+    with open(os.path.join(path, _ARRAYS), "wb") as f:
+        np.savez(f, **arrays)
+        _fsync_file(f)
 
 
 def _load_leaves(template, data) -> tuple:
@@ -57,15 +171,19 @@ def _load_leaves(template, data) -> tuple:
 # --------------------------------------------------------------- federated
 
 
-def save_federated(trainer, path: str, run_name: str | None = None) -> None:
+def save_federated(trainer, path: str, run_name: str | None = None,
+                   keep: int = 1) -> None:
     """Write a full-resume checkpoint of a trainer to ``path``.
 
     Accepts a ``FederatedTrainer`` (kind "federated") or an ``MDGANTrainer``
     (kind "mdgan" — the replicated generator bundle plus the per-client
     discriminator stack).  ``run_name`` (the dataset/output identity, e.g.
     "Intrusion") rides along so a resumed run keeps writing to the same
-    output layout without the original CLI flags."""
-    os.makedirs(path, exist_ok=True)
+    output layout without the original CLI flags.
+
+    The write is crash-safe: staged in a temp dir, fsynced, and published
+    by atomic rename; ``keep`` > 1 retains the previous K-1 checkpoints as
+    ``path.1`` … for :func:`find_resumable`."""
     is_mdgan = hasattr(trainer, "gen")
     if not is_mdgan and not hasattr(trainer, "models"):
         raise TypeError(
@@ -92,21 +210,33 @@ def save_federated(trainer, path: str, run_name: str | None = None) -> None:
         },
         "run_name": run_name,
     }
-    with open(os.path.join(path, _HOST), "wb") as f:
-        pickle.dump(host, f)
-    if is_mdgan:
-        state = (trainer.gen, trainer.disc)
-    elif has_ema:
-        # EMA runs (cfg.ema_decay > 0) persist the smoothed generator too —
-        # resume must continue the same EMA chain bit-exactly
-        state = (trainer.models, trainer.ema)
-    else:
-        state = trainer.models
-    _save_leaves(
-        state,
-        {"rng_key": jax.random.key_data(trainer._key)},
-        path,
-    )
+    tmp = _stage_dir(path)
+    try:
+        with open(os.path.join(tmp, _HOST), "wb") as f:
+            pickle.dump(host, f)
+            _fsync_file(f)
+        _fault_hook(path)  # simulated crash: tmp is partial, path untouched
+        if is_mdgan:
+            state = (trainer.gen, trainer.disc)
+        elif has_ema:
+            # EMA runs (cfg.ema_decay > 0) persist the smoothed generator
+            # too — resume must continue the same EMA chain bit-exactly
+            state = (trainer.models, trainer.ema)
+        else:
+            state = trainer.models
+        _save_leaves(
+            state,
+            {"rng_key": jax.random.key_data(trainer._key)},
+            tmp,
+        )
+        _seal_dir(tmp)
+    except BaseException as exc:
+        # an injected fault SIMULATES a hard crash: leave the partial stage
+        # on disk exactly as kill -9 would, so tests prove resume ignores it
+        if type(exc).__name__ != "FaultInjected":
+            shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _publish_dir(tmp, path, keep)
 
 
 def load_federated(path: str, mesh=None):
@@ -208,8 +338,8 @@ def save_synthesizer(synth, path: str) -> None:
     Accepts a ``StandaloneSynthesizer`` or a ``FederatedTrainer`` (which
     contributes its post-aggregation global generator and the pooled
     conditional sampler, like the reference server's snapshot model).
+    Crash-safe like ``save_federated``: staged, fsynced, atomic rename.
     """
-    os.makedirs(path, exist_ok=True)
     if hasattr(synth, "_global_model"):  # FederatedTrainer
         params_g, state_g = synth._global_model()
         cond = synth.server_cond
@@ -230,9 +360,17 @@ def save_synthesizer(synth, path: str) -> None:
         "output_info": transformer.output_info,
         "key_offset": key_offset,
     }
-    with open(os.path.join(path, _HOST), "wb") as f:
-        pickle.dump(host, f)
-    _save_leaves((params_g, state_g, cond), {}, path)
+    tmp = _stage_dir(path)
+    try:
+        with open(os.path.join(tmp, _HOST), "wb") as f:
+            pickle.dump(host, f)
+            _fsync_file(f)
+        _save_leaves((params_g, state_g, cond), {}, tmp)
+        _seal_dir(tmp)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _publish_dir(tmp, path, keep=1)
 
 
 def load_synthesizer(path: str) -> SavedSynthesizer:

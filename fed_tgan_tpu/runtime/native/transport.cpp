@@ -179,6 +179,65 @@ int ft_server_accept(void* handle, int n_clients, int timeout_ms) {
   return 0;
 }
 
+// Accept ONE (re)connecting client if a connection lands within timeout_ms.
+// The client announces its 4-byte rank exactly like the initial rendezvous;
+// any existing fd for that rank is closed and replaced, so a client that
+// lost its connection can rejoin mid-protocol.  Returns the rank (>= 1),
+// 0 if nothing arrived before the deadline, or a negative error.
+int ft_server_poll_accept(void* handle, int timeout_ms) {
+  auto* ep = static_cast<Endpoint*>(handle);
+  if (!ep || !ep->is_server || ep->peers.empty()) return kErrArg;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  int rc = wait_fd(ep->listen_fd, POLLIN, deadline);
+  if (rc == kErrTimeout) return 0;
+  if (rc < 0) return rc;
+  int cfd = accept(ep->listen_fd, nullptr, nullptr);
+  if (cfd < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    return kErrSocket;
+  }
+  set_common_opts(cfd);
+  uint32_t rank_le = 0;
+  // the rank announcement is 4 bytes from an already-connected peer; give
+  // it a short fixed budget so a half-open connection can't wedge us
+  rc = recv_all(cfd, reinterpret_cast<uint8_t*>(&rank_le), 4,
+                now_ms() + 5000);
+  if (rc < 0) {
+    close(cfd);
+    return rc;
+  }
+  uint32_t rank = le32toh(rank_le);
+  if (rank < 1 || rank > ep->peers.size()) {
+    close(cfd);
+    return kErrArg;
+  }
+  if (ep->peers[rank - 1] >= 0) close(ep->peers[rank - 1]);
+  ep->peers[rank - 1] = cfd;
+  return static_cast<int>(rank);
+}
+
+// Close the connection to one peer (server: 1-based rank; client: 0) while
+// keeping the endpoint alive — marks a dropped client, and lets the
+// fault-injection harness sever a live connection to exercise reconnect.
+int ft_peer_close(void* handle, int peer) {
+  auto* ep = static_cast<Endpoint*>(handle);
+  if (!ep) return kErrArg;
+  size_t idx;
+  if (ep->is_server) {
+    if (peer < 1 || static_cast<size_t>(peer) > ep->peers.size())
+      return kErrArg;
+    idx = static_cast<size_t>(peer - 1);
+  } else {
+    if (ep->peers.empty()) return kErrArg;
+    idx = 0;
+  }
+  if (ep->peers[idx] >= 0) {
+    close(ep->peers[idx]);
+    ep->peers[idx] = -1;
+  }
+  return 0;
+}
+
 // ---- client ----------------------------------------------------------------
 
 // Connect to host:port and announce rank (1-based); retries until deadline
@@ -283,6 +342,20 @@ int ft_recv(void* handle, int peer, uint8_t** out, uint64_t* out_len,
   *out = buf;
   *out_len = len;
   return 0;
+}
+
+// Poll a peer for readability WITHOUT consuming bytes: the Python layer
+// slices its waits to service heartbeats/reconnects, and consuming a
+// partial frame on a slice timeout would corrupt the stream.  Returns 1
+// (readable/EOF), 0 (nothing within timeout), or a negative error.
+int ft_poll(void* handle, int peer, int timeout_ms) {
+  auto* ep = static_cast<Endpoint*>(handle);
+  int fd = peer_fd(ep, peer);
+  if (fd < 0) return kErrArg;
+  int rc = wait_fd(fd, POLLIN, timeout_ms < 0 ? -1 : now_ms() + timeout_ms);
+  if (rc == kErrTimeout) return 0;
+  if (rc < 0) return rc;
+  return 1;
 }
 
 void ft_free(uint8_t* buf) { free(buf); }

@@ -1,0 +1,143 @@
+"""Deterministic fault injection for the fault-tolerance layer.
+
+A ``FaultPlan`` describes failures to inject at exact, reproducible points:
+
+- ``kill_client:rank=R,round=E`` — drop client ``R`` (1-based transport
+  rank) at the start of training round ``E`` (1-based).  In-process
+  training consumes this via ``FederatedTrainer``; multihost clients
+  ``os._exit`` to simulate a hard crash.
+- ``delay_msg:ms=M`` — sleep ``M`` ms before every transport send
+  (uniform message delay, exercises deadline slack).
+- ``sever_conn:rank=R,after=N`` — client ``R`` severs its own live TCP
+  connection after its ``N``-th successful send, exercising
+  reconnect-with-backoff + sequence resync on both sides.
+- ``crash_checkpoint:save=N`` — the ``N``-th ``save_federated`` call in
+  this process raises ``FaultInjected`` mid-write (after some files are
+  on disk, before the atomic publish), simulating a crash that must leave
+  the previous checkpoint loadable.
+
+Plans parse from a spec string (``;``-separated faults, ``,``-separated
+``key=value`` args) passed through the ``--faults`` CLI flag or the
+``FED_TGAN_TPU_FAULTS`` env var (the env var reaches multihost
+subprocesses).  Production code paths consult :func:`active_plan`, which
+is None unless a plan was installed — the harness costs nothing when off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger("fed_tgan_tpu.faults")
+
+ENV_VAR = "FED_TGAN_TPU_FAULTS"
+
+
+class FaultInjected(RuntimeError):
+    """Raised at an injection point that simulates an in-process crash."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Parsed fault spec; all counters are per-process and thread-safe."""
+
+    kill_rank: int = 0          # 0 = no kill fault
+    kill_round: int = 0
+    delay_ms: int = 0
+    sever_rank: int = 0         # 0 = no sever fault
+    sever_after: int = 0
+    crash_save: int = 0         # 0 = no checkpoint-crash fault
+
+    def __post_init__(self) -> None:
+        self._lock = threading.Lock()
+        self._save_calls = 0
+        self._severed = False
+        self._killed = False
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        plan = cls()
+        for part in filter(None, (p.strip() for p in spec.split(";"))):
+            name, _, argstr = part.partition(":")
+            args = {}
+            for kv in filter(None, (a.strip() for a in argstr.split(","))):
+                k, _, v = kv.partition("=")
+                args[k.strip()] = int(v)
+            if name == "kill_client":
+                plan.kill_rank = args["rank"]
+                plan.kill_round = args["round"]
+            elif name == "delay_msg":
+                plan.delay_ms = args["ms"]
+            elif name == "sever_conn":
+                plan.sever_rank = args["rank"]
+                plan.sever_after = args["after"]
+            elif name == "crash_checkpoint":
+                plan.crash_save = args.get("save", 1)
+            else:
+                raise ValueError(f"unknown fault {name!r} in spec {spec!r}")
+        return plan
+
+    # -- injection points -----------------------------------------------------
+
+    def maybe_delay(self) -> None:
+        if self.delay_ms > 0:
+            time.sleep(self.delay_ms / 1000.0)
+
+    def should_sever(self, rank: int, sent_count: int) -> bool:
+        if self.sever_rank != rank or sent_count < self.sever_after:
+            return False
+        with self._lock:
+            if self._severed:
+                return False
+            self._severed = True
+            return True
+
+    def should_kill(self, rank: int, round_1based: int) -> bool:
+        """True exactly once, for client ``rank`` at ``round_1based``."""
+        if self.kill_rank != rank or round_1based < self.kill_round:
+            return False
+        with self._lock:
+            if self._killed:
+                return False
+            self._killed = True
+            return True
+
+    def on_checkpoint_write(self, path: str) -> None:
+        """Called mid-``save_federated`` after partial state is on disk."""
+        if self.crash_save <= 0:
+            return
+        with self._lock:
+            self._save_calls += 1
+            fire = self._save_calls == self.crash_save
+        if fire:
+            log.warning("FAULT: crashing checkpoint save #%d mid-write (%s)",
+                        self.crash_save, path)
+            raise FaultInjected(f"checkpoint save crashed mid-write: {path}")
+
+
+_active: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def install_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with None) the process-wide plan."""
+    global _active, _env_checked
+    _active = plan
+    _env_checked = True  # an explicit install wins over the env var
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The process-wide plan: explicitly installed, or lazily parsed from
+    ``FED_TGAN_TPU_FAULTS`` on first use."""
+    global _active, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        spec = os.environ.get(ENV_VAR)
+        if spec:
+            _active = FaultPlan.parse(spec)
+            log.warning("fault injection active from %s=%r", ENV_VAR, spec)
+    return _active

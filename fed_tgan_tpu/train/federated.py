@@ -28,10 +28,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from fed_tgan_tpu.federation.init import FederatedInit
+from fed_tgan_tpu.federation.init import FederatedInit, renormalize_weights
 from fed_tgan_tpu.ops.segments import SegmentSpec
 from fed_tgan_tpu.parallel.fedavg import replicate_local, weighted_average
-from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, client_mesh, clients_per_device
+from fed_tgan_tpu.parallel.mesh import (
+    CLIENTS_AXIS,
+    client_mesh,
+    clients_per_device,
+    pcast_varying,
+    shard_map,
+)
 from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
 from fed_tgan_tpu.train.steps import (
     SampleProgramCache,
@@ -144,9 +150,7 @@ def make_federated_epoch(
             # mark the zero init as device-varying so the scan carry type
             # matches the per-client metrics produced inside the loop
             zero_metrics = {
-                name: jax.lax.pcast(
-                    jnp.zeros((), jnp.float32), (CLIENTS_AXIS,), to="varying"
-                )
+                name: pcast_varying(jnp.zeros((), jnp.float32), (CLIENTS_AXIS,))
                 for name in ("loss_d", "pen", "loss_g")
             }
 
@@ -211,7 +215,7 @@ def make_federated_epoch(
     if use_ema:
         in_specs.append(P())   # EMA rides replicated, like the key chain
         out_specs.append(P())
-    fn = jax.shard_map(
+    fn = shard_map(
         epoch_local,
         mesh=mesh,
         in_specs=tuple(in_specs),
@@ -362,10 +366,13 @@ class FederatedTrainer(RoundBookkeeping):
         config: TrainConfig | None = None,
         mesh=None,
         seed: int = 0,
+        min_clients: int = 1,
     ):
         self.init = init
         self.cfg = config or TrainConfig()
         self.seed = seed
+        self.min_clients = min_clients
+        self.dropped_clients: set[int] = set()
         n_clients = len(init.client_matrices)
         self.n_clients = n_clients
         if mesh is None:
@@ -451,6 +458,56 @@ class FederatedTrainer(RoundBookkeeping):
             )
         return self._epoch_fns[rounds]
 
+    def drop_client(self, idx: int, reason: str = "") -> None:
+        """Drop client ``idx`` (0-based) from all future rounds.
+
+        The client's local step budget goes to zero (it stops computing) and
+        the similarity-derived aggregation weights are renormalized over the
+        survivors — the paper's weighting restricted to live clients.  The
+        device program's shape is unchanged (no recompile); only the steps
+        and weights device arrays are re-uploaded.  Raises ``RuntimeError``
+        (clean abort, never a hang) if survivors would fall below
+        ``min_clients``."""
+        if not 0 <= idx < self.n_clients:
+            raise IndexError(f"client index {idx} out of range")
+        if idx in self.dropped_clients:
+            return
+        survivors = self.n_clients - len(self.dropped_clients) - 1
+        if survivors < self.min_clients:
+            raise RuntimeError(
+                f"aborting: dropping client {idx} leaves {survivors} live "
+                f"clients, below min_clients={self.min_clients}"
+            )
+        self.dropped_clients.add(idx)
+        alive = np.ones(self.n_clients, dtype=bool)
+        alive[list(self.dropped_clients)] = False
+        self.weights = renormalize_weights(self.weights, alive)
+        self.steps = np.where(alive, self.steps, 0)
+        if self._device_stacks is not None:
+            data, cond, rows, _, _ = self._device_stacks
+            self._device_stacks = (
+                data, cond, rows,
+                self._shard(jnp.asarray(self.steps)),
+                self._shard(jnp.asarray(self.weights)),
+            )
+        import logging
+
+        logging.getLogger("fed_tgan_tpu.train").warning(
+            "dropped client %d%s; weights renormalized over %d survivors",
+            idx, f" ({reason})" if reason else "", survivors,
+        )
+
+    def _fault_kill_due(self, e: int):
+        """(plan, 0-based kill round) when a kill_client fault is pending."""
+        try:
+            from fed_tgan_tpu.testing.faults import active_plan
+        except Exception:
+            return None
+        plan = active_plan()
+        if plan is None or not plan.kill_rank:
+            return None
+        return plan
+
     def fit(self, epochs: int, log_every: int = 0, sample_hook=None,
             hook_epochs=None, max_rounds_per_call: int = 16,
             on_nonfinite: str = "warn"):
@@ -497,8 +554,17 @@ class FederatedTrainer(RoundBookkeeping):
             )
 
         while e < end:
+            plan = self._fault_kill_due(e)
+            if plan is not None and plan.should_kill(plan.kill_rank, e + 1):
+                self.drop_client(plan.kill_rank - 1,
+                                 f"fault-injected kill at round {e + 1}")
+                data, cond, rows, steps, weights = self._device_stacks
             nxt = min((f for f in firing if f >= e), default=end - 1)
             size = min(nxt - e + 1, max_rounds_per_call, end - e)
+            if plan is not None and e + 1 < plan.kill_round <= e + size:
+                # land a chunk boundary exactly at the kill round so the
+                # injected drop is deterministic wrt round fusion
+                size = plan.kill_round - 1 - e
             # last-good, for a failed sync
             prev = (self.models, self._key, self.ema, self._ema_updates)
             t0 = time.time()

@@ -48,7 +48,12 @@ from fed_tgan_tpu.federation.init import FederatedInit
 from fed_tgan_tpu.models.ctgan import discriminator_apply, generator_apply
 from fed_tgan_tpu.models.losses import gradient_penalty
 from fed_tgan_tpu.ops.segments import SegmentSpec, apply_activate, cond_loss
-from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, client_mesh, clients_per_device
+from fed_tgan_tpu.parallel.mesh import (
+    CLIENTS_AXIS,
+    client_mesh,
+    clients_per_device,
+    shard_map,
+)
 from fed_tgan_tpu.train.federated import (
     RoundBookkeeping,
     all_finite_flag,
@@ -216,7 +221,7 @@ def make_mdgan_epoch(spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, 
         )
 
     rep, shd = P(), P(CLIENTS_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map(
         epoch_local,
         mesh=mesh,
         in_specs=(rep, shd, shd, shd, shd, shd, rep),

@@ -74,6 +74,25 @@ class MultihostRun:
     save_every: int = 0
     ckpt_dir: str | None = None
     resume: bool = False
+    snapshot_format: str = "csv"  # csv | feather | parquet (server-side)
+
+
+def _maybe_fault_kill(rank: int, round_1based: int) -> None:
+    """Fault-injection point: a multihost client scheduled to die at this
+    round hard-exits (``os._exit``), simulating a crashed participant —
+    the server's heartbeat-lapse detection turns that into a clean abort."""
+    try:
+        from fed_tgan_tpu.testing.faults import active_plan
+    except Exception:
+        return
+    plan = active_plan()
+    if plan is not None and plan.should_kill(rank, round_1based):
+        import logging
+        import os
+
+        logging.getLogger("fed_tgan_tpu.faults").warning(
+            "FAULT: rank %d hard-exiting at round %d", rank, round_1based)
+        os._exit(17)
 
 
 def _snapshot_epochs(run: MultihostRun) -> set[int]:
@@ -364,6 +383,7 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
 
     with sender if sender is not None else contextlib.nullcontext():
         while e < end:
+            _maybe_fault_kill(transport.rank, e + 1)
             nxt = min((f for f in boundaries if f >= e), default=end - 1)
             size = min(nxt - e + 1, run.max_rounds_per_call, end - e)
             if size not in epoch_fns:
@@ -493,24 +513,62 @@ def server_train(
     # tables and the assemble is swapped before that snapshot is written
     assemble = assemble_for_meta(init_out["global_meta"])
 
+    fmt = run.snapshot_format or "csv"
+    if fmt not in ("csv", "feather", "parquet"):
+        # fail fast: silently writing CSVs under a different name would
+        # betray the --snapshot-format contract
+        raise ValueError(f"unknown snapshot format {fmt!r} "
+                         "(expected csv, feather or parquet)")
+
     books = RoundBookkeeping()
     books._init_bookkeeping()
 
     def write_snapshot(epoch: int, parts: dict, asm) -> None:
         from fed_tgan_tpu.data.decode import decode_and_write_csv
+        from fed_tgan_tpu.train.snapshots import _write_columnar
 
-        # same arrow-direct fast path as the single-host SnapshotWriter
-        decode_and_write_csv(
-            asm(parts), init_out["global_meta"], init_out["encoders"],
-            os.path.join(result_dir, f"{name}_synthesis_epoch_{epoch}.csv"),
-        )
+        path = os.path.join(result_dir,
+                            f"{name}_synthesis_epoch_{epoch}.{fmt}")
+        if fmt == "csv":
+            # same arrow-direct fast path as the single-host SnapshotWriter
+            decode_and_write_csv(
+                asm(parts), init_out["global_meta"], init_out["encoders"],
+                path,
+            )
+        else:
+            _write_columnar(
+                asm(parts), init_out["global_meta"], init_out["encoders"],
+                path, fmt,
+            )
 
     # decode/CSV-write runs on a worker so the recv loop keeps draining the
     # socket while pandas churns (the single-host SnapshotWriter behavior);
     # the with-block settles in-flight writes and re-raises worker errors
+    from fed_tgan_tpu.runtime.transport import TransportError
+
+    def recv_or_abort(rank: int, timeout_ms=None):
+        """A dead/late participant aborts the run CLEANLY: the SPMD mesh
+        cannot lose a live member mid-collective, so the failure story here
+        is heartbeat-lapse detection + per-rank checkpoints (--save-every)
+        + resume, not weight renormalization (which the in-process trainer
+        and the init protocol do support)."""
+        try:
+            # positional timeout only when set: test fakes (and any minimal
+            # transport) need only the single-arg recv_obj signature
+            if timeout_ms is None:
+                return transport.recv_obj(rank)
+            return transport.recv_obj(rank, timeout_ms)
+        except TransportError as exc:
+            raise RuntimeError(
+                f"multihost training aborted: rank {rank} unreachable "
+                f"({exc}); relaunch with --resume to continue from the "
+                "per-rank checkpoints"
+            ) from exc
+
     with AsyncWorker(max_pending=2) as writer:
         while True:
-            msg = transport.recv_obj(1)
+            msg = recv_or_abort(1, getattr(transport, "deadlines", None)
+                                and transport.deadlines.train_ms)
             if msg["type"] == "done":
                 finals = [(msg["params_g"], msg.get("ema"))]
                 break
@@ -531,7 +589,7 @@ def server_train(
                 print(f"[server] round {msg['last']}: {per_round:.3f}s/round")
 
     finals += [
-        (lambda m: (m["params_g"], m.get("ema")))(transport.recv_obj(rank))
+        (lambda m: (m["params_g"], m.get("ema")))(recv_or_abort(rank))
         for rank in range(2, transport.n_clients + 1)
     ]
     # the check covers the EMA chain too when enabled (None collapses to an

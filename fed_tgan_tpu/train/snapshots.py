@@ -101,6 +101,7 @@ def _write_columnar(data, meta, encoders, path: str, fmt: str):
     formatting at all — the write is memcpy-level).  Opt-in via
     FED_TGAN_TPU_SNAPSHOT_FORMAT / --snapshot-format; the reference's
     offline eval tooling reads CSVs, so CSV stays the default."""
+    import numpy as np
     import pyarrow as pa
 
     from fed_tgan_tpu.data.decode import decode_matrix, decode_to_table
@@ -109,7 +110,16 @@ def _write_columnar(data, meta, encoders, path: str, fmt: str):
     out = table
     if table is None:  # dates / missing sentinels: exact pandas path
         out = decode_matrix(data, meta, encoders)
-        table = pa.Table.from_pandas(out, preserve_index=False)
+        # decode_matrix spells missing values as the ``' '`` sentinel (the
+        # reference's CSV convention), which leaves numeric columns as mixed
+        # float/str object dtype — pa.Table.from_pandas raises ArrowInvalid
+        # on those.  Map the sentinel to null so columnar formats carry true
+        # nulls; the returned frame keeps the sentinel for CSV parity.
+        # mask instead of .replace: identical nulling without pandas'
+        # deprecated silent-downcasting behavior (FutureWarning)
+        table = pa.Table.from_pandas(
+            out.mask(out == " ", np.nan), preserve_index=False
+        )
     if fmt == "feather":
         # feather V2 == the Arrow IPC file format (write_feather itself is
         # deprecated in favor of this); pd.read_feather reads it back

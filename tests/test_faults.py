@@ -1,0 +1,364 @@
+"""Fault-tolerance layer under deterministic fault injection.
+
+The acceptance scenarios from the robustness PR: client dropout with
+similarity-weight renormalization, clean aborts below the min_clients
+floor, crash-safe checkpoint publication with auto-resume, and transport
+sever/reconnect with sequence resync.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.testing.faults import (
+    FaultInjected,
+    FaultPlan,
+    active_plan,
+    install_plan,
+)
+
+PORT = 27000 + (os.getpid() * 17) % 5000
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    """Every test starts and ends with NO process-wide fault plan."""
+    install_plan(None)
+    yield
+    install_plan(None)
+
+
+# -- plan grammar -------------------------------------------------------------
+
+
+def test_fault_plan_parse_grammar():
+    plan = FaultPlan.parse(
+        "kill_client:rank=3,round=2;delay_msg:ms=50;"
+        "sever_conn:rank=1,after=2;crash_checkpoint:save=4"
+    )
+    assert (plan.kill_rank, plan.kill_round) == (3, 2)
+    assert plan.delay_ms == 50
+    assert (plan.sever_rank, plan.sever_after) == (1, 2)
+    assert plan.crash_save == 4
+    # crash_checkpoint defaults to the first save
+    assert FaultPlan.parse("crash_checkpoint").crash_save == 1
+    with pytest.raises(ValueError, match="unknown fault"):
+        FaultPlan.parse("set_on_fire:rank=1")
+
+
+def test_fault_plan_fires_once():
+    plan = FaultPlan.parse("kill_client:rank=2,round=3")
+    assert not plan.should_kill(2, 2)  # not yet
+    assert not plan.should_kill(1, 3)  # wrong rank
+    assert plan.should_kill(2, 3)
+    assert not plan.should_kill(2, 4)  # once only
+    sever = FaultPlan.parse("sever_conn:rank=1,after=2")
+    assert not sever.should_sever(1, 1)
+    assert sever.should_sever(1, 2)
+    assert not sever.should_sever(1, 3)
+
+
+def test_active_plan_env_parse(monkeypatch):
+    import fed_tgan_tpu.testing.faults as faults
+
+    monkeypatch.setenv(faults.ENV_VAR, "delay_msg:ms=7")
+    monkeypatch.setattr(faults, "_active", None)
+    monkeypatch.setattr(faults, "_env_checked", False)
+    plan = active_plan()
+    assert plan is not None and plan.delay_ms == 7
+
+
+# -- weight renormalization ---------------------------------------------------
+
+
+def test_renormalize_weights():
+    from fed_tgan_tpu.federation.init import renormalize_weights
+
+    w = np.array([0.4, 0.3, 0.2, 0.1])
+    out = renormalize_weights(w, np.array([True, True, False, True]))
+    assert out[2] == 0.0
+    np.testing.assert_allclose(out.sum(), 1.0, atol=1e-6)
+    np.testing.assert_allclose(out[0] / out[1], w[0] / w[1], atol=1e-6)
+    with pytest.raises(ValueError, match="no surviving clients"):
+        renormalize_weights(w, np.zeros(4, dtype=bool))
+
+
+# -- in-process trainer dropout ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fed_init(toy_frame, toy_spec):
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.init import federated_initialize
+
+    shards = shard_dataframe(toy_frame, 4, "iid", seed=9)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    return federated_initialize(clients, seed=0)
+
+
+def _cfg():
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    return TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                       batch_size=40, pac=4)
+
+
+def test_trainer_survives_injected_client_kill(fed_init):
+    """The PR's dropout acceptance scenario: 4 clients, rank 3 killed at
+    round 2 — training completes, the dead client's weight is exactly 0,
+    survivors' weights renormalize to sum 1, and sampling still works."""
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+
+    install_plan(FaultPlan.parse("kill_client:rank=3,round=2"))
+    tr = FederatedTrainer(fed_init, config=_cfg(), mesh=client_mesh(4),
+                          seed=0, min_clients=1)
+    tr.fit(epochs=4)
+    assert tr.completed_epochs == 4
+    assert tr.dropped_clients == {2}  # rank 3 = client index 2
+    assert tr.weights[2] == 0.0
+    np.testing.assert_allclose(tr.weights.sum(), 1.0, atol=1e-5)
+    # surviving weights keep their pre-drop ratios
+    w0 = np.asarray(fed_init.weights)
+    np.testing.assert_allclose(tr.weights[0] / tr.weights[1],
+                               w0[0] / w0[1], atol=1e-5)
+    out = tr.sample(100, seed=1)
+    assert len(out) == 100
+
+
+def test_trainer_aborts_below_min_clients(fed_init):
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+
+    tr = FederatedTrainer(fed_init, config=_cfg(), mesh=client_mesh(4),
+                          seed=0, min_clients=4)
+    with pytest.raises(RuntimeError, match="below min_clients"):
+        tr.drop_client(1)
+    assert tr.dropped_clients == set()  # the refused drop changed nothing
+
+
+# -- crash-safe checkpoints ---------------------------------------------------
+
+
+def test_checkpoint_crash_leaves_previous_loadable(fed_init, tmp_path):
+    """The PR's checkpoint acceptance scenario: a save killed mid-write
+    leaves the previous checkpoint loadable, and auto-resume restores it
+    bit-for-bit."""
+    import jax
+
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.runtime.checkpoint import (
+        find_resumable,
+        load_federated,
+        save_federated,
+    )
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+
+    mesh = client_mesh(4)
+    path = str(tmp_path / "ckpt")
+    tr = FederatedTrainer(fed_init, config=_cfg(), mesh=mesh, seed=0)
+    tr.fit(epochs=1)
+    save_federated(tr, path, run_name="toy")
+    want = [np.asarray(x) for x in jax.tree.leaves(tr.models)]
+
+    # the NEXT save crashes mid-write (partial stage on disk, no publish)
+    tr.fit(epochs=1)
+    install_plan(FaultPlan.parse("crash_checkpoint:save=1"))
+    with pytest.raises(FaultInjected):
+        save_federated(tr, path, run_name="toy")
+    # the torn stage is left behind (like a real kill -9 would) but the
+    # published checkpoint is untouched and auto-resume finds it
+    assert find_resumable(path) == path
+    back = load_federated(path, mesh=mesh)
+    assert back.completed_epochs == 1
+    for a, b in zip(want, jax.tree.leaves(back.models)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+    # a later (healthy) save sweeps the stale stage and publishes round 2
+    install_plan(None)
+    save_federated(tr, path, run_name="toy")
+    assert not [e for e in os.listdir(tmp_path) if ".tmp-" in e]
+    assert load_federated(path, mesh=mesh).completed_epochs == 2
+
+
+def test_checkpoint_rotation_and_fallback(fed_init, tmp_path):
+    """keep=2 retains the previous generation; when the primary slot is
+    torn, find_resumable falls back to it."""
+    import shutil
+
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.runtime.checkpoint import (
+        find_resumable,
+        load_federated,
+        save_federated,
+    )
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+
+    mesh = client_mesh(4)
+    path = str(tmp_path / "ckpt")
+    tr = FederatedTrainer(fed_init, config=_cfg(), mesh=mesh, seed=0)
+    tr.fit(epochs=1)
+    save_federated(tr, path, keep=2)
+    tr.fit(epochs=1)
+    save_federated(tr, path, keep=2)
+    assert load_federated(path, mesh=mesh).completed_epochs == 2
+    assert load_federated(path + ".1", mesh=mesh).completed_epochs == 1
+
+    # tear the primary (simulate a corrupted slot): fallback to .1
+    os.remove(os.path.join(path, "host.pkl"))
+    assert find_resumable(path) == path + ".1"
+    # nothing valid at all -> None
+    shutil.rmtree(path)
+    shutil.rmtree(path + ".1")
+    assert find_resumable(path) is None
+
+
+# -- transport sever / reconnect ---------------------------------------------
+
+
+def test_transport_sever_reconnect_no_duplicates():
+    """The PR's transport acceptance scenario: a connection severed after a
+    successful send reconnects with backoff + sequence resync, and every
+    payload arrives exactly once on both sides."""
+    from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
+
+    install_plan(FaultPlan.parse("sever_conn:rank=1,after=1"))
+    port = PORT
+    got_client = []
+
+    def client():
+        with ClientTransport("127.0.0.1", port, 1, timeout_ms=30_000) as c:
+            for i in range(3):
+                c.send_obj({"seq": i})  # send #1 severs its own socket
+                got_client.append(c.recv_obj())
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    got_server = []
+    with ServerTransport(port, 1, timeout_ms=30_000) as server:
+        for i in range(3):
+            got_server.append(server.recv_obj(1))
+            server.send_obj(1, {"echo": got_server[-1]["seq"]})
+    t.join(timeout=30)
+    assert got_server == [{"seq": i} for i in range(3)]
+    assert got_client == [{"echo": i} for i in range(3)]
+
+
+def test_transport_delay_fault_still_delivers():
+    from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
+
+    install_plan(FaultPlan.parse("delay_msg:ms=30"))
+    port = PORT + 1
+    result = {}
+
+    def client():
+        with ClientTransport("127.0.0.1", port, 1, timeout_ms=30_000) as c:
+            c.send_obj("ping")
+            result["echo"] = c.recv_obj()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    with ServerTransport(port, 1, timeout_ms=30_000) as server:
+        server.send_obj(1, server.recv_obj(1))
+    t.join(timeout=30)
+    assert result["echo"] == "ping"
+
+
+def test_init_protocol_completes_across_severed_connection(toy_frame,
+                                                           toy_spec):
+    """Acceptance: a client whose connection is severed DURING init
+    reconnects with backoff and the protocol completes with the exact same
+    artifacts as the in-process path — no duplicate-message effects."""
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.distributed import (
+        client_initialize,
+        server_initialize,
+    )
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.runtime.transport import ClientTransport, ServerTransport
+
+    shards = shard_dataframe(toy_frame, 2, "iid", seed=4)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    # rank 1 severs its own connection right after its first send (the
+    # local meta): the next protocol step must ride a reconnect + resync
+    install_plan(FaultPlan.parse("sever_conn:rank=1,after=1"))
+    port = PORT + 3
+    out = {}
+
+    def run_client(rank):
+        with ClientTransport("127.0.0.1", port, rank, timeout_ms=60_000) as t:
+            out[rank] = client_initialize(t, clients[rank - 1], seed=0)
+
+    threads = [threading.Thread(target=run_client, args=(r,), daemon=True)
+               for r in (1, 2)]
+    for t in threads:
+        t.start()
+    with ServerTransport(port, 2, timeout_ms=60_000) as st:
+        server_out = server_initialize(st, seed=0)
+    for t in threads:
+        t.join(timeout=60)
+
+    reference = federated_initialize(clients, seed=0)
+    np.testing.assert_allclose(server_out["weights"], reference.weights,
+                               atol=1e-6)
+    assert server_out["dropped"] == []
+    for rank in (1, 2):
+        np.testing.assert_allclose(out[rank]["weights"], reference.weights,
+                                   atol=1e-6)
+
+
+# -- init-protocol dropout ----------------------------------------------------
+
+
+def test_server_initialize_drops_dead_client_and_renormalizes(toy_frame,
+                                                              toy_spec):
+    """A client that dies mid-protocol is dropped; with min_clients set the
+    survivors' weights renormalize and the init completes."""
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.distributed import (
+        client_initialize,
+        server_initialize,
+    )
+    from fed_tgan_tpu.runtime.transport import (
+        ClientTransport,
+        Deadlines,
+        ServerTransport,
+    )
+
+    shards = shard_dataframe(toy_frame, 3, "iid", seed=4)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    port = PORT + 2
+    out = {}
+
+    def run_client(rank):
+        with ClientTransport("127.0.0.1", port, rank, timeout_ms=60_000) as t:
+            if rank == 3:
+                # dies after the first phase: sends its meta, then vanishes
+                t.send_obj(clients[2].local_meta())
+                return
+            out[rank] = client_initialize(t, clients[rank - 1], seed=0)
+
+    threads = [threading.Thread(target=run_client, args=(r,), daemon=True)
+               for r in (1, 2, 3)]
+    for t in threads:
+        t.start()
+    # short heartbeat timeout (but > the 2 s heartbeat interval) so the
+    # dead rank is declared quickly while live ranks stay healthy
+    dl = Deadlines(init_ms=30_000, heartbeat_timeout_ms=5_000)
+    with ServerTransport(port, 3, timeout_ms=20_000, deadlines=dl) as st:
+        server_out = server_initialize(st, seed=0, min_clients=2)
+    for t in threads:
+        t.join(timeout=60)
+
+    assert server_out["live_ranks"] == [1, 2]
+    assert 3 in server_out["dropped"]
+    assert len(server_out["weights"]) == 2
+    np.testing.assert_allclose(np.sum(server_out["weights"]), 1.0, atol=1e-6)
+    for rank in (1, 2):
+        np.testing.assert_allclose(out[rank]["weights"],
+                                   server_out["weights"], atol=1e-6)

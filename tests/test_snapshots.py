@@ -169,6 +169,47 @@ def test_snapshot_writer_columnar_formats(trainer, tmp_path):
         SnapshotWriter(init.global_meta, init.encoders, str, fmt="xlsx")
 
 
+def test_write_columnar_missing_values_fallback(tmp_path):
+    """The exact-pandas fallback inside _write_columnar must handle missing
+    values: decode_matrix spells them as the ' ' sentinel, leaving numeric
+    columns as mixed float/str object dtype — from_pandas used to raise
+    ArrowInvalid on those.  Columnar formats must carry true nulls instead,
+    while the returned frame keeps the sentinel for CSV parity."""
+    import pandas as pd
+
+    from fed_tgan_tpu.data.constants import (
+        CATEGORICAL,
+        MISSING_CONTINUOUS,
+        MISSING_TOKEN,
+    )
+    from fed_tgan_tpu.data.encoders import CategoryEncoder
+    from fed_tgan_tpu.data.schema import ColumnMeta, TableMeta
+    from fed_tgan_tpu.train.snapshots import _write_columnar
+
+    enc = CategoryEncoder(classes_=np.asarray(
+        ["a", MISSING_TOKEN, "z"], dtype=object))
+    meta = TableMeta(columns=[
+        ColumnMeta(name="c", kind=CATEGORICAL, index=0,
+                   i2s=["a", MISSING_TOKEN, "z"]),
+        ColumnMeta(name="x", kind="continuous", index=1, min=0.0, max=1.0),
+    ])
+    # row 1 carries the missing sentinel in the continuous column, which
+    # forces decode_to_table to punt to the pandas path
+    mat = np.asarray([[0.0, 0.5], [1.0, MISSING_CONTINUOUS], [2.0, 0.25]])
+    for fmt, reader in (("feather", pd.read_feather),
+                        ("parquet", pd.read_parquet)):
+        path = str(tmp_path / f"snap.{fmt}")
+        out = _write_columnar(mat, meta, [enc], path, fmt)
+        # the RETURNED frame keeps decode_matrix's sentinel spelling
+        assert out.loc[1, "x"] == " "
+        assert out.loc[1, "c"] == " "
+        got = reader(path)
+        assert pd.isna(got.loc[1, "x"])  # columnar file carries a true null
+        assert got.loc[0, "x"] == pytest.approx(0.5)
+        assert list(got["c"].astype(object).where(got["c"].notna(), None))[
+            0] == "a"
+
+
 def test_snapshot_writer_error_propagates(trainer, tmp_path):
     init = trainer.init
     writer = SnapshotWriter(
