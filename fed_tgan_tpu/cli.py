@@ -132,6 +132,53 @@ def build_parser() -> argparse.ArgumentParser:
                         "weights over the survivors, continue while at "
                         "least this many clients remain (default: every "
                         "client required; any dropout aborts cleanly)")
+    p.add_argument("--aggregator", type=str, default="weighted",
+                   choices=["weighted", "clipped", "trimmed", "median"],
+                   help="FedAvg aggregation rule: weighted = the paper's "
+                        "similarity-weighted mean (reference protocol); "
+                        "clipped = delta norms capped at --update-clip x "
+                        "the median before the weighted mean; trimmed = "
+                        "coordinate-wise trimmed mean (--trim-ratio per "
+                        "side); median = coordinate-wise median.  The "
+                        "robust rules tolerate Byzantine/poisoned updates "
+                        "at some statistical efficiency cost (PARITY.md)")
+    p.add_argument("--no-update-gate", action="store_true",
+                   help="disable the pre-aggregation update validation "
+                        "gate (NaN/Inf screen + median-based norm outlier "
+                        "quarantine).  On by default; clean runs are "
+                        "bit-identical either way")
+    p.add_argument("--gate-norm-factor", type=float, default=10.0,
+                   help="update gate: quarantine a client whose delta norm "
+                        "is more than this factor above OR below the "
+                        "median client's (default 10)")
+    p.add_argument("--update-clip", type=float, default=3.0,
+                   help="clipped aggregator: cap each client's delta norm "
+                        "at this multiple of the median norm (default 3)")
+    p.add_argument("--trim-ratio", type=float, default=0.2,
+                   help="trimmed aggregator: fraction of clients trimmed "
+                        "from each extreme per coordinate (default 0.2)")
+    p.add_argument("--quarantine-strikes", type=int, default=3,
+                   help="evict a client after this many quarantined rounds "
+                        "(weights renormalize over survivors, down to the "
+                        "--min-clients floor; default 3)")
+    p.add_argument("--watchdog", action="store_true",
+                   help="training-health watchdog: on loss explosion/NaN "
+                        "or sustained similarity regression, roll back to "
+                        "the last good checkpoint (--save-every), re-anneal "
+                        "the lr, retry --watchdog-max-rollbacks times, then "
+                        "abort cleanly")
+    p.add_argument("--watchdog-loss-threshold", type=float, default=100.0,
+                   help="|loss| above this counts as an explosion")
+    p.add_argument("--watchdog-similarity-factor", type=float, default=2.0,
+                   help="monitor reads worse than this factor x the best "
+                        "Avg_JSD count as regression (needs "
+                        "--monitor-every)")
+    p.add_argument("--watchdog-patience", type=int, default=3,
+                   help="consecutive regressed monitor reads before alarm")
+    p.add_argument("--watchdog-max-rollbacks", type=int, default=2,
+                   help="rollbacks before the run aborts cleanly")
+    p.add_argument("--watchdog-lr-reanneal", type=float, default=0.5,
+                   help="learning-rate multiplier applied on each rollback")
     p.add_argument("--faults", type=str, default=None, metavar="SPEC",
                    help="deterministic fault-injection plan for testing "
                         "the fault-tolerance paths, e.g. "
@@ -356,6 +403,11 @@ def _run_multihost_init(args) -> int:
                     lr_decay_steps=_lr_decay_steps(
                         args, max(int(r) for r in out["rows_per_client"])),
                     allow_zero_step_clients=args.allow_zero_step_clients,
+                    aggregator=args.aggregator,
+                    update_gate=not args.no_update_gate,
+                    gate_norm_factor=args.gate_norm_factor,
+                    update_clip=args.update_clip,
+                    trim_ratio=args.trim_ratio,
                 )
                 client_train(t, out, cfg, make_run())
                 print(f"rank {args.rank} training complete")
@@ -626,7 +678,12 @@ def main(argv=None) -> int:
                       lr_schedule=args.lr_schedule,
                       lr_decay_steps=_lr_decay_steps(
                           args, max(len(f) for f in frames)),
-                      allow_zero_step_clients=args.allow_zero_step_clients)
+                      allow_zero_step_clients=args.allow_zero_step_clients,
+                      aggregator=args.aggregator,
+                      update_gate=not args.no_update_gate,
+                      gate_norm_factor=args.gate_norm_factor,
+                      update_clip=args.update_clip,
+                      trim_ratio=args.trim_ratio)
     if args.mode == "standalone":
         # no participants, no harmonization/refit protocol — skip the
         # federated construction entirely
@@ -653,7 +710,8 @@ def main(argv=None) -> int:
         trainer = MDGANTrainer(init, config=cfg, seed=args.seed)
     else:
         trainer = FederatedTrainer(init, config=cfg, seed=args.seed,
-                                   min_clients=args.min_clients or 1)
+                                   min_clients=args.min_clients or 1,
+                                   quarantine_strikes=args.quarantine_strikes)
     return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
 
 
@@ -875,6 +933,27 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     def mon_due(e: int) -> bool:
         return monitor is not None and monitor_due(e)
 
+    watchdog = None
+    if args.watchdog:
+        if not hasattr(trainer, "_epoch_fn_for"):
+            print("note: --watchdog is not supported for this trainer; ignoring")
+        else:
+            from fed_tgan_tpu.train.watchdog import (
+                TrainingWatchdog,
+                WatchdogConfig,
+            )
+
+            watchdog = TrainingWatchdog(WatchdogConfig(
+                loss_threshold=args.watchdog_loss_threshold,
+                similarity_factor=args.watchdog_similarity_factor,
+                similarity_patience=args.watchdog_patience,
+                max_rollbacks=args.watchdog_max_rollbacks,
+                lr_reanneal=args.watchdog_lr_reanneal,
+            ))
+            if not args.save_every:
+                print("note: --watchdog without --save-every has no "
+                      "checkpoint to roll back to; an alarm aborts cleanly")
+
     def hook(e, tr):
         if snapshot_due(e):
             snapshot(e, tr)
@@ -886,6 +965,10 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
                     f"round {e}: Avg_JSD={m['avg_jsd']:.4f} "
                     f"Avg_WD={m['avg_wd']:.4f} (on-device monitor)"
                 )
+            if watchdog is not None:
+                # BEFORE the checkpoint branch below: a regressed round
+                # must never be persisted as "good"
+                watchdog.observe_similarity(e, m["avg_jsd"])
         if save_due(e):
             from fed_tgan_tpu.runtime.checkpoint import save_federated
 
@@ -924,9 +1007,23 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     with mon_log:
         with snapshot:  # waits for in-flight snapshot CSVs, re-raises errors
             if remaining - prof_n:
-                trainer.fit(remaining - prof_n, log_every=log_every,
+                if watchdog is not None:
+                    from fed_tgan_tpu.train.watchdog import fit_with_watchdog
+
+                    # rollback replaces the trainer instance (reloaded from
+                    # the checkpoint), so reassign it here
+                    trainer = fit_with_watchdog(
+                        trainer, remaining - prof_n, watchdog, ckpt_dir,
+                        fit_kwargs=dict(
+                            log_every=log_every,
                             sample_hook=hook if use_hook else None,
-                            **fit_kwargs)
+                            **fit_kwargs,
+                        ),
+                    )
+                else:
+                    trainer.fit(remaining - prof_n, log_every=log_every,
+                                sample_hook=hook if use_hook else None,
+                                **fit_kwargs)
             if prof_n:
                 from fed_tgan_tpu.runtime.profiling import device_trace
 

@@ -164,6 +164,60 @@ def check_compile_cache() -> bool:
                  f"{sub} ({n} entries, machine fingerprint {fp})")
 
 
+def check_robust_aggregation() -> bool:
+    """Each robust aggregator rejects a poisoned client on a tiny pytree.
+
+    4 clients with near-identical updates, one shipping NaNs and (second
+    scenario) one shipping a 1000x-scaled delta: the gated aggregate must
+    stay finite and land near the clean clients' mean.  Host-side variants
+    — the same math as the in-graph path, no device needed."""
+    import numpy as np
+
+    from fed_tgan_tpu.parallel.fedavg import host_robust_aggregate
+
+    prev = {"w": np.zeros((3, 2), np.float32), "b": np.zeros(3, np.float32)}
+    rng = np.random.default_rng(0)
+    clean = [
+        {"w": prev["w"] + 0.1 + 0.01 * rng.standard_normal((3, 2)).astype(np.float32),
+         "b": prev["b"] - 0.1 + 0.01 * rng.standard_normal(3).astype(np.float32)}
+        for _ in range(4)
+    ]
+    weights = np.full(4, 0.25)
+    poisons = {
+        "nan": {k: np.full_like(v, np.nan) for k, v in clean[3].items()},
+        "scale": {k: prev[k] + 1000.0 * (v - prev[k])
+                  for k, v in clean[3].items()},
+    }
+    clean_mean = {
+        k: np.mean([c[k] for c in clean[:3]], axis=0) for k in prev
+    }
+    try:
+        for pname, poison in poisons.items():
+            trees = clean[:3] + [poison]
+            for agg in ("weighted", "clipped", "trimmed", "median"):
+                out, quar = host_robust_aggregate(
+                    prev, trees, weights, aggregator=agg)
+                if not quar[3] or quar[:3].any():
+                    return _line(False, "robust-agg",
+                                 f"{agg}/{pname}: gate flagged {quar} "
+                                 "(expected only client 3)")
+                for k in prev:
+                    if not np.isfinite(out[k]).all():
+                        return _line(False, "robust-agg",
+                                     f"{agg}/{pname}: non-finite {k}")
+                    if np.abs(out[k] - clean_mean[k]).max() > 0.05:
+                        return _line(False, "robust-agg",
+                                     f"{agg}/{pname}: {k} strayed "
+                                     f"{np.abs(out[k] - clean_mean[k]).max():.3f} "
+                                     "from the clean mean")
+    except Exception as exc:
+        return _line(False, "robust-agg", f"{exc!r}")
+    return _line(True, "robust-agg",
+                 "weighted/clipped/trimmed/median all quarantined the "
+                 "poisoned client (nan + 1000x-scale) and stayed on the "
+                 "clean mean")
+
+
 def wait_healthy(timeout_min: float = 0.0, quiet_min: float = 45.0,
                  probe_timeout_s: int = 120,
                  _probe=None, _load=None, _sleep=None, _log=print) -> bool:
@@ -270,6 +324,7 @@ def main(argv=None) -> int:
         check_backend(args.probe_timeout),
         check_virtual_mesh(args.mesh_devices),
         check_transport(),
+        check_robust_aggregation(),
         check_compile_cache(),
     ]
     bad = checks.count(False)

@@ -69,6 +69,46 @@ def _gather_phase(
     return results
 
 
+def _all_finite(obj) -> bool:
+    """Every float reachable in ``obj`` (containers, arrays, plain objects)
+    is finite.  Non-numeric leaves pass vacuously."""
+    if isinstance(obj, (bool, int, str, bytes)) or obj is None:
+        return True
+    if isinstance(obj, float):
+        return np.isfinite(obj)
+    if isinstance(obj, np.ndarray):
+        return not np.issubdtype(obj.dtype, np.floating) or bool(
+            np.isfinite(obj).all()
+        )
+    if isinstance(obj, np.generic):
+        return not np.issubdtype(obj.dtype, np.floating) or bool(
+            np.isfinite(obj)
+        )
+    if isinstance(obj, dict):
+        return all(_all_finite(v) for v in obj.values())
+    if isinstance(obj, (list, tuple, set)):
+        return all(_all_finite(v) for v in obj)
+    d = getattr(obj, "__dict__", None)
+    return _all_finite(d) if d is not None else True
+
+
+def _validate_gathered(
+    transport: ServerTransport, phase: str, min_clients: int | None,
+    results: dict[int, object],
+) -> dict[int, object]:
+    """Screen gathered init payloads for NaN/Inf — a client whose local
+    GMM fit diverged (or that is hostile) must not poison the harmonized
+    global artifacts.  Offenders are dropped exactly like a dead socket:
+    logged, excluded, weights renormalized over survivors, subject to the
+    same ``min_clients`` floor."""
+    bad = [r for r in sorted(results) if not _all_finite(results[r])]
+    for r in bad:
+        transport.mark_dropped(r, f"non-finite payload in init {phase}")
+        del results[r]
+    _check_floor(transport, phase + "-validate", min_clients, bad)
+    return results
+
+
 def _broadcast_phase(
     transport: ServerTransport, obj: object, phase: str,
     min_clients: int | None,
@@ -113,7 +153,10 @@ def server_initialize(
         "broadcast-meta", min_clients,
     )
 
-    infos = _gather_phase(transport, "gather-gmms", min_clients)
+    infos = _validate_gathered(
+        transport, "gather-gmms", min_clients,
+        _gather_phase(transport, "gather-gmms", min_clients),
+    )
     info_ranks = sorted(infos)  # [{"gmms": [...], "rows": int}] by rank
     client_gmms = [infos[r]["gmms"] for r in info_ranks]
     rows_by_rank = {r: infos[r]["rows"] for r in info_ranks}
@@ -130,7 +173,10 @@ def server_initialize(
     # Cond on the FULL training table (distributed.py:565-580); here the
     # clients exchange additive one-hot counts instead of rows, so the
     # pooled distribution is identical without centralizing any data
-    counts = _gather_phase(transport, "gather-cond-counts", min_clients)
+    counts = _validate_gathered(
+        transport, "gather-cond-counts", min_clients,
+        _gather_phase(transport, "gather-cond-counts", min_clients),
+    )
     cond_counts = sum(counts[r] for r in sorted(counts))
 
     # the weighting runs over the ranks that survived EVERY phase; a rank

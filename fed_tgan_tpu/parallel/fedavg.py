@@ -36,3 +36,308 @@ def weighted_average(tree, weights: jax.Array, axis_name: str = CLIENTS_AXIS):
 def replicate_local(tree, k: int):
     """Broadcast averaged leaves back to the per-local-client layout."""
     return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (k,) + x.shape), tree)
+
+
+# --------------------------------------------------------------------------
+# Byzantine-robust aggregation.
+#
+# The reference trusts every client state_dict blindly; here a validation
+# gate screens each delta for NaN/Inf and a median-based norm outlier test
+# (two-sided: the high side catches scaled/poisoned updates, the low side
+# stuck clients replaying stale params), renormalizes the similarity
+# weights over the survivors, and feeds one of four aggregators.  All of it
+# runs in-graph over the clients mesh axis so the gate costs one extra
+# all_gather of scalars per round; host-side numpy twins below serve the
+# socket path, doctor checks, and parity tests.
+# --------------------------------------------------------------------------
+
+_EPS = 1e-12
+
+
+def _delta_norms(prev, new, k: int):
+    """Per-local-client finite flags and delta L2 norms, over all leaves.
+
+    Returns ``(finite, norm)``, both shape (k,).  Non-finite entries are
+    masked to 0 before the sum-of-squares so a single NaN cannot poison the
+    norm of an otherwise-informative delta (the finite flag already damns
+    that client).
+    """
+    finite = jnp.ones((k,), dtype=bool)
+    sumsq = jnp.zeros((k,), dtype=jnp.float32)
+    for p, n in zip(jax.tree.leaves(prev), jax.tree.leaves(new)):
+        if not jnp.issubdtype(n.dtype, jnp.floating):
+            continue
+        d = n.astype(jnp.float32) - p.astype(jnp.float32)
+        d = d.reshape(k, -1)
+        ok = jnp.isfinite(d)
+        finite = finite & ok.all(axis=1)
+        sumsq = sumsq + jnp.sum(jnp.where(ok, d, 0.0) ** 2, axis=1)
+    return finite, jnp.sqrt(sumsq)
+
+
+def robust_aggregate(
+    prev,
+    new,
+    weights: jax.Array,
+    steps: jax.Array,
+    k: int,
+    aggregator: str = "weighted",
+    update_gate: bool = True,
+    gate_norm_factor: float = 10.0,
+    update_clip: float = 3.0,
+    trim_ratio: float = 0.2,
+    axis_name: str = CLIENTS_AXIS,
+):
+    """Gate + aggregate client parameter trees inside shard_map.
+
+    ``prev``/``new`` leaves carry a leading local-clients axis of size
+    ``k``; ``prev`` is the replicated pre-round state (every client's slice
+    holds the same global values, so ``leaf[0]`` IS the global prev).
+    ``weights``/``steps`` are the local (k,) slices.  Returns
+    ``(agg_tree, quarantined)``: leaves WITHOUT the leading axis (replicated
+    global result) and a local (k,) float mask of clients the gate rejected
+    this round.
+
+    When every alive client passes the gate the effective weights are the
+    ORIGINAL weights (scalar select, not a renormalized copy), so the
+    ``weighted`` aggregator reproduces :func:`weighted_average`
+    bit-identically on clean rounds.
+    """
+    gather = lambda x: jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+    rank = jax.lax.axis_index(axis_name)
+
+    finite_l, norm_l = _delta_norms(prev, new, k)
+    finite_g = gather(finite_l)
+    norm_g = gather(norm_l)
+    w_g = gather(weights.astype(jnp.float32))
+    steps_g = gather(steps.astype(jnp.int32))
+
+    alive = w_g > 0
+    trained = steps_g > 0
+    if update_gate:
+        # median-based two-sided norm outlier test over clients that are
+        # alive, finite, and actually trained this round (zero-step clients
+        # legitimately ship zero deltas)
+        consider = alive & finite_g & trained
+        med = jnp.nanmedian(jnp.where(consider, norm_g, jnp.nan))
+        med_ok = jnp.isfinite(med) & (med > 0)
+        bad_norm = (
+            med_ok
+            & trained
+            & ((norm_g > gate_norm_factor * med)
+               | (norm_g * gate_norm_factor < med))
+        )
+        valid = alive & finite_g & ~bad_norm
+    else:
+        med = jnp.nanmedian(jnp.where(alive & finite_g, norm_g, jnp.nan))
+        valid = alive & finite_g
+
+    all_valid = (valid == alive).all()
+    wz = jnp.where(valid, w_g, 0.0)
+    s = wz.sum()
+    any_valid = s > 0
+    # bit-exact passthrough: a clean round uses the original weights, an
+    # attacked round the survivor-renormalized ones
+    w_eff_g = jnp.where(all_valid, w_g, wz / jnp.maximum(s, _EPS))
+
+    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, rank * k, k, axis=0)
+    valid_l = sl(valid)
+    w_eff_l = sl(w_eff_g)
+
+    def expand(mask, leaf):
+        return mask.reshape((k,) + (1,) * (leaf.ndim - 1))
+
+    # sanitize BEFORE any weighted arithmetic: NaN * 0 is NaN, so invalid
+    # clients' leaves are replaced by their (replicated, finite) prev values
+    san = jax.tree.map(
+        lambda p, n: jnp.where(expand(valid_l, n), n, p), prev, new
+    )
+
+    if aggregator == "weighted":
+        agg = weighted_average(san, w_eff_l, axis_name)
+    elif aggregator == "clipped":
+        # norm-clipped weighted mean of deltas around the global prev:
+        # scale_i = min(1, update_clip * median_norm / norm_i)
+        safe_med = jnp.where(jnp.isfinite(med) & (med > 0), med, 1.0)
+        scale_g = jnp.minimum(
+            1.0, update_clip * safe_med / jnp.maximum(norm_g, _EPS)
+        )
+        cw_l = w_eff_l * sl(scale_g)
+
+        def clip_avg(p, n):
+            d = n.astype(jnp.float32) - p.astype(jnp.float32)
+            local = jnp.tensordot(cw_l, d, axes=1)
+            step = jax.lax.psum(local, axis_name)
+            return (p[0].astype(jnp.float32) + step).astype(n.dtype)
+
+        agg = jax.tree.map(clip_avg, prev, san)
+    elif aggregator == "trimmed":
+        m = valid.sum()
+        t = jnp.minimum(
+            jnp.floor(trim_ratio * m).astype(jnp.int32),
+            jnp.maximum((m - 1) // 2, 0),
+        )
+
+        def trim_mean(leaf):
+            g = gather(leaf.astype(jnp.float32))          # (n, ...)
+            n_total = g.shape[0]
+            mask = valid.reshape((n_total,) + (1,) * (g.ndim - 1))
+            g = jnp.where(mask, g, jnp.inf)               # invalid sort last
+            g = jnp.sort(g, axis=0)
+            idx = jnp.arange(n_total).reshape(
+                (n_total,) + (1,) * (g.ndim - 1)
+            )
+            keep = (idx >= t) & (idx < m - t)
+            total = jnp.sum(jnp.where(keep, g, 0.0), axis=0)
+            return (total / jnp.maximum(m - 2 * t, 1)).astype(leaf.dtype)
+
+        agg = jax.tree.map(trim_mean, san)
+    elif aggregator == "median":
+
+        def coord_median(leaf):
+            g = gather(leaf.astype(jnp.float32))
+            mask = valid.reshape((g.shape[0],) + (1,) * (g.ndim - 1))
+            g = jnp.where(mask, g, jnp.nan)
+            return jnp.nanmedian(g, axis=0).astype(leaf.dtype)
+
+        agg = jax.tree.map(coord_median, san)
+    else:
+        raise ValueError(
+            f"unknown aggregator {aggregator!r}; "
+            "expected weighted|clipped|trimmed|median"
+        )
+
+    # if the gate rejected EVERYONE, keep the previous global state rather
+    # than publishing garbage
+    agg = jax.tree.map(
+        lambda a, p: jnp.where(any_valid, a, p[0].astype(a.dtype)), agg, prev
+    )
+    quarantined = (sl(alive) & ~valid_l).astype(jnp.float32)
+    return agg, quarantined
+
+
+# -- host-side (numpy) twins for the socket path, doctor, and parity tests --
+
+
+def host_weighted_average(trees: list, weights):
+    """sum_i w_i * leaf_i over a list of client pytrees (numpy/host)."""
+    import numpy as np
+
+    w = np.asarray(weights, dtype=np.float64)
+    leaves = [jax.tree.leaves(t) for t in trees]
+    treedef = jax.tree.structure(trees[0])
+    out = []
+    for li in zip(*leaves):
+        stack = np.stack([np.asarray(x, dtype=np.float64) for x in li])
+        out.append(np.tensordot(w, stack, axes=1).astype(np.asarray(li[0]).dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+def host_robust_aggregate(
+    prev,
+    new_trees: list,
+    weights,
+    steps=None,
+    aggregator: str = "weighted",
+    update_gate: bool = True,
+    gate_norm_factor: float = 10.0,
+    update_clip: float = 3.0,
+    trim_ratio: float = 0.2,
+):
+    """Host-side mirror of :func:`robust_aggregate`.
+
+    ``prev`` is the single global pytree; ``new_trees`` is one updated
+    pytree per client.  Returns ``(agg_tree, quarantined)`` with
+    ``quarantined`` a (n,) bool array.  Same gate math as the in-graph
+    version, without the mesh.
+    """
+    import numpy as np
+
+    n = len(new_trees)
+    w = np.asarray(weights, dtype=np.float64)
+    steps_arr = (np.asarray(steps, dtype=np.int64) if steps is not None
+                 else np.ones(n, dtype=np.int64))
+    prev_leaves = jax.tree.leaves(prev)
+    treedef = jax.tree.structure(prev)
+    client_leaves = [jax.tree.leaves(t) for t in new_trees]
+
+    finite = np.ones(n, dtype=bool)
+    sumsq = np.zeros(n, dtype=np.float64)
+    for j, p in enumerate(prev_leaves):
+        p64 = np.asarray(p, dtype=np.float64)
+        if not np.issubdtype(np.asarray(p).dtype, np.floating):
+            continue
+        for i in range(n):
+            d = np.asarray(client_leaves[i][j], dtype=np.float64) - p64
+            ok = np.isfinite(d)
+            finite[i] &= bool(ok.all())
+            sumsq[i] += float(np.sum(np.where(ok, d, 0.0) ** 2))
+    norm = np.sqrt(sumsq)
+
+    alive = w > 0
+    trained = steps_arr > 0
+    if update_gate:
+        consider = alive & finite & trained
+        med = np.median(norm[consider]) if consider.any() else np.nan
+        med_ok = np.isfinite(med) and med > 0
+        bad_norm = (
+            med_ok
+            & trained
+            & ((norm > gate_norm_factor * med)
+               | (norm * gate_norm_factor < med))
+        )
+        valid = alive & finite & ~bad_norm
+    else:
+        valid = alive & finite
+
+    s = w[valid].sum()
+    any_valid = s > 0
+    if (valid == alive).all():
+        w_eff = w.copy()
+    else:
+        w_eff = np.where(valid, w, 0.0) / max(s, _EPS)
+
+    med_for_clip = (np.median(norm[valid & trained])
+                    if (valid & trained).any() else np.nan)
+    safe_med = med_for_clip if np.isfinite(med_for_clip) and med_for_clip > 0 else 1.0
+
+    out = []
+    for j, p in enumerate(prev_leaves):
+        p64 = np.asarray(p, dtype=np.float64)
+        dtype = np.asarray(p).dtype
+        # sanitized stack: invalid clients contribute prev (finite) values
+        stack = np.stack([
+            np.asarray(client_leaves[i][j], dtype=np.float64)
+            if valid[i] else p64
+            for i in range(n)
+        ])
+        if not any_valid:
+            out.append(p64.astype(dtype))
+            continue
+        if aggregator == "weighted":
+            out.append(np.tensordot(w_eff, stack, axes=1).astype(dtype))
+        elif aggregator == "clipped":
+            scale = np.minimum(1.0, update_clip * safe_med
+                               / np.maximum(norm, _EPS))
+            cw = w_eff * scale
+            out.append((p64 + np.tensordot(cw, stack - p64, axes=1))
+                       .astype(dtype))
+        elif aggregator == "trimmed":
+            m = int(valid.sum())
+            t = min(int(np.floor(trim_ratio * m)), max((m - 1) // 2, 0))
+            g = np.where(valid.reshape((n,) + (1,) * (stack.ndim - 1)),
+                         stack, np.inf)
+            g = np.sort(g, axis=0)
+            sub = g[t:m - t]
+            out.append((sub.sum(axis=0) / max(m - 2 * t, 1)).astype(dtype))
+        elif aggregator == "median":
+            g = np.where(valid.reshape((n,) + (1,) * (stack.ndim - 1)),
+                         stack, np.nan)
+            out.append(np.nanmedian(g, axis=0).astype(dtype))
+        else:
+            raise ValueError(
+                f"unknown aggregator {aggregator!r}; "
+                "expected weighted|clipped|trimmed|median"
+            )
+    quarantined = alive & ~valid
+    return jax.tree.unflatten(treedef, out), quarantined

@@ -126,20 +126,28 @@ def _is_valid_checkpoint(path: str) -> bool:
         return False
 
 
+def list_resumable(path: str, max_rotations: int = 8) -> list[str]:
+    """Every valid checkpoint generation at ``path``, newest first
+    (primary, then rotation slots ``path.1`` …).  The watchdog walks this
+    list when the newest generation turns out to hold already-poisoned
+    state (a corruption at round E only surfaces in round E+1's losses,
+    after E's checkpoint was published)."""
+    candidates = [path] + [f"{path}.{i}" for i in range(1, max_rotations + 1)]
+    return [c for c in candidates
+            if os.path.isdir(c) and _is_valid_checkpoint(c)]
+
+
 def find_resumable(path: str, max_rotations: int = 8) -> str | None:
     """Newest valid checkpoint at ``path`` (or its rotation slots
     ``path.1`` … — a crash can leave the primary slot empty or torn while
     an older rotation is intact).  None when nothing loadable exists."""
-    candidates = [path] + [f"{path}.{i}" for i in range(1, max_rotations + 1)]
-    for cand in candidates:
-        if os.path.isdir(cand) and _is_valid_checkpoint(cand):
-            if cand != path:
-                log.warning(
-                    "checkpoint: primary %s unusable, resuming from %s",
-                    path, cand,
-                )
-            return cand
-    return None
+    gens = list_resumable(path, max_rotations)
+    if gens and gens[0] != path:
+        log.warning(
+            "checkpoint: primary %s unusable, resuming from %s",
+            path, gens[0],
+        )
+    return gens[0] if gens else None
 
 
 def _fault_hook(path: str) -> None:

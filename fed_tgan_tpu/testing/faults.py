@@ -15,6 +15,21 @@ A ``FaultPlan`` describes failures to inject at exact, reproducible points:
   this process raises ``FaultInjected`` mid-write (after some files are
   on disk, before the atomic publish), simulating a crash that must leave
   the previous checkpoint loadable.
+- ``nan_update:rank=R[,round=E][,until=U]`` — client ``R`` (1-based)
+  ships all-NaN parameters after its local training each round in the
+  window [E, U] (E defaults to 1, U=0 means forever) — the classic
+  diverged/hostile update the aggregation gate must quarantine.
+- ``scale_update:factor=F,rank=R[,round=E][,until=U]`` (bare
+  ``scale_update:F`` reads F positionally, rank defaults to 1) — client
+  ``R`` scales its parameter DELTA by ``F`` (model-poisoning shape:
+  finite but norm-anomalous).
+- ``stuck_update:rank=R[,round=E][,until=U]`` — client ``R`` replays its
+  stale pre-round parameters (zero delta), the silent-failure shape the
+  low-norm side of the outlier test catches.
+
+The update faults are baked into the jitted epoch program at trace time;
+the trainers force chunk boundaries at the window edges so fused rounds
+stay deterministic (see :func:`update_fault_window`).
 
 Plans parse from a spec string (``;``-separated faults, ``,``-separated
 ``key=value`` args) passed through the ``--faults`` CLI flag or the
@@ -51,6 +66,15 @@ class FaultPlan:
     sever_rank: int = 0         # 0 = no sever fault
     sever_after: int = 0
     crash_save: int = 0         # 0 = no checkpoint-crash fault
+    update_kind: str = ""       # "" = no update fault; nan | scale | stuck
+    update_rank: int = 0        # 1-based client rank shipping bad updates
+    update_factor: float = 1.0  # delta scale for kind == "scale"
+    update_round: int = 1       # first faulty round (1-based)
+    update_until: int = 0       # last faulty round (0 = forever)
+
+    VALID_KINDS = ("crash_checkpoint", "delay_msg", "kill_client",
+                   "nan_update", "scale_update", "sever_conn",
+                   "stuck_update")
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
@@ -65,8 +89,13 @@ class FaultPlan:
             name, _, argstr = part.partition(":")
             args = {}
             for kv in filter(None, (a.strip() for a in argstr.split(","))):
-                k, _, v = kv.partition("=")
-                args[k.strip()] = int(v)
+                k, eq, v = kv.partition("=")
+                if not eq and name == "scale_update":
+                    # reference-style positional factor: scale_update:100
+                    args["factor"] = float(k)
+                    continue
+                k = k.strip()
+                args[k] = float(v) if k == "factor" else int(v)
             if name == "kill_client":
                 plan.kill_rank = args["rank"]
                 plan.kill_round = args["round"]
@@ -77,8 +106,18 @@ class FaultPlan:
                 plan.sever_after = args["after"]
             elif name == "crash_checkpoint":
                 plan.crash_save = args.get("save", 1)
+            elif name in ("nan_update", "scale_update", "stuck_update"):
+                plan.update_kind = name.split("_", 1)[0]
+                plan.update_rank = int(args.get("rank", 1))
+                plan.update_factor = float(args.get("factor", 1.0))
+                plan.update_round = int(args.get("round", 1))
+                plan.update_until = int(args.get("until", 0))
             else:
-                raise ValueError(f"unknown fault {name!r} in spec {spec!r}")
+                # fail fast: a typo like 'nan_updat' must not silently no-op
+                raise ValueError(
+                    f"unknown fault {name!r} in spec {spec!r}; valid kinds: "
+                    f"{', '.join(cls.VALID_KINDS)}"
+                )
         return plan
 
     # -- injection points -----------------------------------------------------
@@ -117,6 +156,33 @@ class FaultPlan:
             log.warning("FAULT: crashing checkpoint save #%d mid-write (%s)",
                         self.crash_save, path)
             raise FaultInjected(f"checkpoint save crashed mid-write: {path}")
+
+
+def update_fault_window(
+    plan: Optional[FaultPlan], e0: int, size: int
+) -> tuple[Optional[tuple[str, int, float]], int]:
+    """Resolve the update fault for a chunk of fused rounds.
+
+    ``e0`` is the 0-based index of the first round in the chunk and ``size``
+    its length.  Returns ``(fault, clipped_size)`` where ``fault`` is
+    ``(kind, client_idx0, factor)`` if EVERY round in the (possibly clipped)
+    chunk lies inside the fault window, else None.  ``clipped_size`` shrinks
+    the chunk so fault activity never flips mid-chunk — the fault is a
+    trace-time constant of the fused epoch program.
+    """
+    if plan is None or not plan.update_kind:
+        return None, size
+    lo = plan.update_round - 1                       # 0-based first faulty
+    hi = plan.update_until - 1 if plan.update_until else None  # 0-based last
+    # boundaries where activity flips, relative to e0
+    for edge in sorted(x for x in (lo, (hi + 1) if hi is not None else None)
+                       if x is not None and e0 < x < e0 + size):
+        size = edge - e0
+        break
+    active = e0 >= lo and (hi is None or e0 <= hi)
+    fault = ((plan.update_kind, plan.update_rank - 1, plan.update_factor)
+             if active else None)
+    return fault, size
 
 
 _active: Optional[FaultPlan] = None

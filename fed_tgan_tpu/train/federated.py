@@ -30,7 +30,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fed_tgan_tpu.federation.init import FederatedInit, renormalize_weights
 from fed_tgan_tpu.ops.segments import SegmentSpec
-from fed_tgan_tpu.parallel.fedavg import replicate_local, weighted_average
+from fed_tgan_tpu.parallel.fedavg import (
+    replicate_local,
+    robust_aggregate,
+    weighted_average,
+)
 from fed_tgan_tpu.parallel.mesh import (
     CLIENTS_AXIS,
     client_mesh,
@@ -112,18 +116,37 @@ def all_finite_flag(metrics) -> jnp.ndarray:
     """Replicated scalar: True iff every metric leaf is finite on every
     client (a diverged client poisons the psum, so pmin over the axis).
     Shared by both training engines so the host fetches ONE bool per device
-    call instead of every metric array."""
-    finite = jnp.stack(
-        [jnp.isfinite(m).all() for m in jax.tree.leaves(metrics)]
-    ).all()
+    call instead of every metric array.
+
+    A ``"quarantined"`` metrics entry (added by the update-validation gate)
+    is not itself a loss and EXCUSES same-shaped non-finite loss entries:
+    a diverged client the gate already contained must not abort training.
+    """
+    if isinstance(metrics, dict) and "quarantined" in metrics:
+        q = metrics["quarantined"] > 0
+        finite = jnp.stack([
+            (jnp.isfinite(m) | q).all() if m.shape == q.shape
+            else jnp.isfinite(m).all()
+            for name, m in metrics.items() if name != "quarantined"
+        ]).all()
+    else:
+        finite = jnp.stack(
+            [jnp.isfinite(m).all() for m in jax.tree.leaves(metrics)]
+        ).all()
     return jax.lax.pmin(finite.astype(jnp.int32), CLIENTS_AXIS) > 0
 
 
 def make_federated_epoch(
     spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, k: int,
-    rounds: int = 1,
+    rounds: int = 1, update_fault=None,
 ):
     """Build the jitted SPMD program for ``rounds`` federated rounds.
+
+    ``update_fault`` is ``(kind, client_idx0, factor)`` from
+    :func:`fed_tgan_tpu.testing.faults.update_fault_window` (or None): the
+    named client's post-training parameters are corrupted every round of
+    this program — a trace-time constant, so the callers force chunk
+    boundaries at the fault window's edges.
 
     Arguments of the returned function (all with leading n_clients axis,
     sharded over 'clients', except ``key`` which is replicated):
@@ -173,20 +196,69 @@ def make_federated_epoch(
         return jax.vmap(run_one)(models, data, cond, rows, steps_i, jnp.arange(k))
 
     use_ema = cfg.ema_decay > 0.0
+    # the legacy single-psum path compiles only when nothing robust can
+    # trigger: it is bit-identical to the gated weighted path on clean
+    # rounds, but skipping the gate's all_gathers keeps old programs byte-
+    # for-byte unchanged for cache hits
+    use_robust = (cfg.update_gate or cfg.aggregator != "weighted"
+                  or update_fault is not None)
 
     def epoch_local(models, data, cond, rows, steps_i, weight, key, *ema_in):
         avg = partial(weighted_average, weights=weight)
 
+        def corrupt_updates(prev_trees, new_trees):
+            """Apply the injected update fault to the faulty client's slice
+            (post-training, pre-aggregation — exactly where a hostile or
+            diverged client corrupts the protocol)."""
+            kind, fidx, factor = update_fault
+            rank = jax.lax.axis_index(CLIENTS_AXIS)
+            mask = (rank * k + jnp.arange(k)) == fidx  # (k,) local clients
+
+            def corrupt(p, n):
+                if not jnp.issubdtype(n.dtype, jnp.floating):
+                    return n
+                m = mask.reshape((k,) + (1,) * (n.ndim - 1))
+                if kind == "nan":
+                    bad = jnp.full_like(n, jnp.nan)
+                elif kind == "scale":
+                    bad = p + jnp.asarray(factor, n.dtype) * (n - p)
+                else:  # stuck: replay the stale pre-round params
+                    bad = p
+                return jnp.where(m, bad, n)
+
+            return jax.tree.map(corrupt, prev_trees, new_trees)
+
         def round_body(carry, _):
             models_c, chain, ema_c = carry
+            # pre-round state is replicated across the k axis (every slice
+            # holds the global model), which robust_aggregate relies on
+            prev_agg = (models_c.params_g, models_c.params_d,
+                        models_c.state_g)
             # same split protocol the host loop used, now on device
             chain, rkey = jax.random.split(chain)
             models_c, metrics = one_round(models_c, data, cond, rows, steps_i, rkey)
             # ---- the entire Fed-TGAN communication round: one weighted psum
-            avg_g, avg_sg = avg(models_c.params_g), avg(models_c.state_g)
+            new_agg = (models_c.params_g, models_c.params_d,
+                       models_c.state_g)
+            if update_fault is not None:
+                new_agg = corrupt_updates(prev_agg, new_agg)
+            if use_robust:
+                (avg_g, avg_d, avg_sg), quar = robust_aggregate(
+                    prev_agg, new_agg, weight, steps_i, k,
+                    aggregator=cfg.aggregator,
+                    update_gate=cfg.update_gate,
+                    gate_norm_factor=cfg.gate_norm_factor,
+                    update_clip=cfg.update_clip,
+                    trim_ratio=cfg.trim_ratio,
+                )
+                metrics = dict(metrics)
+                metrics["quarantined"] = quar
+            else:
+                new_g, new_d, new_sg = new_agg
+                avg_g, avg_d, avg_sg = avg(new_g), avg(new_d), avg(new_sg)
             models_c = models_c._replace(
                 params_g=replicate_local(avg_g, k),
-                params_d=replicate_local(avg(models_c.params_d), k),
+                params_d=replicate_local(avg_d, k),
                 state_g=replicate_local(avg_sg, k),
             )
             if use_ema:
@@ -303,12 +375,20 @@ class RoundBookkeeping:
         resumed from before it.  ``mode``: 'ignore' | 'warn' | 'raise'."""
         if mode == "ignore":
             return
+        q = None
+        if isinstance(metrics, dict) and "quarantined" in metrics:
+            q = np.asarray(metrics["quarantined"]) > 0
         # earliest bad round across ALL metrics — divergence usually shows in
         # one loss first, and that round is what a resume should predate
         bad = None
         for name, leaf in metrics.items():
+            if name == "quarantined":
+                continue
             arr = np.asarray(leaf)
-            finite = np.isfinite(arr).reshape(arr.shape[0], -1).all(axis=1)
+            fin = np.isfinite(arr)
+            if q is not None and fin.shape == q.shape:
+                fin = fin | q  # the gate already contained this client
+            finite = fin.reshape(arr.shape[0], -1).all(axis=1)
             if not finite.all():
                 r = first_epoch + int(np.argmin(finite))
                 if bad is None or r < bad[1]:
@@ -367,14 +447,19 @@ class FederatedTrainer(RoundBookkeeping):
         mesh=None,
         seed: int = 0,
         min_clients: int = 1,
+        quarantine_strikes: int = 3,
     ):
         self.init = init
         self.cfg = config or TrainConfig()
         self.seed = seed
         self.min_clients = min_clients
+        self.quarantine_strikes = quarantine_strikes
         self.dropped_clients: set[int] = set()
         n_clients = len(init.client_matrices)
         self.n_clients = n_clients
+        # per-client count of rounds the update gate rejected; reaching
+        # quarantine_strikes evicts the client (down to min_clients)
+        self._strikes = np.zeros(n_clients, dtype=np.int64)
         if mesh is None:
             n_dev = len(jax.devices())
             if n_clients % n_dev == 0:
@@ -450,13 +535,14 @@ class FederatedTrainer(RoundBookkeeping):
         spec = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         return jax.device_put(tree, spec)
 
-    def _epoch_fn_for(self, rounds: int):
-        if rounds not in self._epoch_fns:
-            self._epoch_fns[rounds] = make_federated_epoch(
+    def _epoch_fn_for(self, rounds: int, update_fault=None):
+        key = (rounds, update_fault)
+        if key not in self._epoch_fns:
+            self._epoch_fns[key] = make_federated_epoch(
                 self.spec, self.cfg, self.max_steps, self.mesh, self.k,
-                rounds=rounds,
+                rounds=rounds, update_fault=update_fault,
             )
-        return self._epoch_fns[rounds]
+        return self._epoch_fns[key]
 
     def drop_client(self, idx: int, reason: str = "") -> None:
         """Drop client ``idx`` (0-based) from all future rounds.
@@ -510,7 +596,7 @@ class FederatedTrainer(RoundBookkeeping):
 
     def fit(self, epochs: int, log_every: int = 0, sample_hook=None,
             hook_epochs=None, max_rounds_per_call: int = 16,
-            on_nonfinite: str = "warn"):
+            on_nonfinite: str = "warn", health_cb=None):
         """Run ``epochs`` federated rounds; optionally call
         ``sample_hook(epoch, self)`` after each (the reference snapshots a
         40k-row synthetic CSV per epoch, distributed.py:820).
@@ -522,6 +608,11 @@ class FederatedTrainer(RoundBookkeeping):
         stretches in between collapse to single host round trips, up to
         ``max_rounds_per_call`` rounds each (bounds compile time and how much
         wall-clock one call can hold).
+
+        ``health_cb(first_round, metrics)`` (the training watchdog's hook)
+        runs after each chunk with the host metric arrays, BEFORE the
+        sample hook — so a round the watchdog rejects (by raising) is never
+        checkpointed as "good".
         """
         models = self._shard(self.models)
         if self._device_stacks is None:
@@ -565,18 +656,27 @@ class FederatedTrainer(RoundBookkeeping):
                 # land a chunk boundary exactly at the kill round so the
                 # injected drop is deterministic wrt round fusion
                 size = plan.kill_round - 1 - e
+            from fed_tgan_tpu.testing.faults import (
+                active_plan,
+                update_fault_window,
+            )
+
+            # the update fault is a trace-time constant of the fused
+            # program, so the chunk is clipped to the fault window's edges
+            update_fault, size = update_fault_window(active_plan(), e, size)
             # last-good, for a failed sync
             prev = (self.models, self._key, self.ema, self._ema_updates)
             t0 = time.time()
             if use_ema:
                 (models, metrics, self._key, finite,
-                 self.ema) = self._epoch_fn_for(size)(
+                 self.ema) = self._epoch_fn_for(size, update_fault)(
                     models, data, cond, rows, steps, weights, self._key,
                     self.ema,
                 )
                 self._ema_updates += size
             else:
-                models, metrics, self._key, finite = self._epoch_fn_for(size)(
+                (models, metrics, self._key,
+                 finite) = self._epoch_fn_for(size, update_fault)(
                     models, data, cond, rows, steps, weights, self._key
                 )
             # divergence check: ONE scalar crosses to host (fetching it also
@@ -619,6 +719,36 @@ class FederatedTrainer(RoundBookkeeping):
             ok = on_nonfinite == "ignore" or bool(finite)
             if not ok:
                 self._check_finite(metrics, e, on_nonfinite)
+            if isinstance(metrics, dict) and "quarantined" in metrics:
+                q = np.asarray(metrics["quarantined"]) > 0.5  # (size, n)
+                if q.any():
+                    counts = q.sum(axis=0).astype(np.int64)
+                    self._strikes += counts
+                    import logging
+
+                    logg = logging.getLogger("fed_tgan_tpu.train")
+                    for idx in np.nonzero(counts)[0]:
+                        logg.warning(
+                            "update gate quarantined client %d for %d of "
+                            "rounds %d..%d (strikes %d/%d)",
+                            idx, counts[idx], e, e + size - 1,
+                            self._strikes[idx], self.quarantine_strikes,
+                        )
+                    # evict repeat offenders (clean RuntimeError below the
+                    # min_clients floor); survivors' weights renormalize
+                    for idx in np.nonzero(
+                        self._strikes >= self.quarantine_strikes
+                    )[0]:
+                        if int(idx) not in self.dropped_clients:
+                            self.drop_client(
+                                int(idx),
+                                f"quarantined {self._strikes[idx]} rounds "
+                                f"(strike limit {self.quarantine_strikes})",
+                            )
+                    data, cond, rows, steps, weights = self._device_stacks
+            if health_cb is not None:
+                health_cb(e, {name: np.asarray(v)
+                              for name, v in metrics.items()})
             per_round = (time.time() - t0 - t_pre) / size
             for ei in range(e, e + size):
                 self._finish_round(

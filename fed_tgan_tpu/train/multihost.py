@@ -381,23 +381,35 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
         boundaries |= {r for r in range(e_start, end)
                        if (r + 1) % run.save_every == 0}
 
+    from fed_tgan_tpu.testing.faults import active_plan, update_fault_window
+
     with sender if sender is not None else contextlib.nullcontext():
         while e < end:
             _maybe_fault_kill(transport.rank, e + 1)
             nxt = min((f for f in boundaries if f >= e), default=end - 1)
             size = min(nxt - e + 1, run.max_rounds_per_call, end - e)
-            if size not in epoch_fns:
-                epoch_fns[size] = make_federated_epoch(
-                    spec, cfg, max_steps, mesh, k=1, rounds=size
+            # injected update faults are trace-time constants of the fused
+            # program: clip the chunk to the fault window's edges, exactly
+            # like FederatedTrainer.fit.  Every rank computes the same
+            # (size, fault) so the SPMD programs stay in lockstep.  There is
+            # no host-side eviction here — a mesh cannot shrink mid-run —
+            # but the in-graph gate re-masks the offender every round, and
+            # the replicated quarantine metric keeps all ranks agreeing.
+            update_fault, size = update_fault_window(active_plan(), e, size)
+            fn_key = (size, update_fault)
+            if fn_key not in epoch_fns:
+                epoch_fns[fn_key] = make_federated_epoch(
+                    spec, cfg, max_steps, mesh, k=1, rounds=size,
+                    update_fault=update_fault,
                 )
             t0 = time.time()
             if use_ema:
-                models_g, metrics, chain, _finite, ema_g = epoch_fns[size](
+                models_g, metrics, chain, _finite, ema_g = epoch_fns[fn_key](
                     models_g, data_g, cond_g, rows_g, steps_g, weights_g,
                     chain, ema_g,
                 )
             else:
-                models_g, metrics, chain, _finite = epoch_fns[size](
+                models_g, metrics, chain, _finite = epoch_fns[fn_key](
                     models_g, data_g, cond_g, rows_g, steps_g, weights_g,
                     chain,
                 )

@@ -78,6 +78,15 @@ class TrainConfig:
     # Off by default: an all-IID run hitting this is a misconfiguration,
     # so the loud guard stays unless the caller opts into skewed shards.
     allow_zero_step_clients: bool = False
+    # Update-robustness knobs (the reference trusts every client blindly;
+    # see PARITY.md).  All defaults keep clean trajectories bit-identical:
+    # the gate's effective weights are a scalar select of the originals
+    # when every client passes.
+    aggregator: str = "weighted"     # weighted | clipped | trimmed | median
+    update_gate: bool = True         # NaN/Inf + norm-outlier screening
+    gate_norm_factor: float = 10.0   # two-sided median-ratio threshold
+    update_clip: float = 3.0         # delta-norm cap (x median), clipped agg
+    trim_ratio: float = 0.2          # per-side fraction, trimmed agg
 
 
 def lr_decay_horizon(lr_schedule: str, epochs: int, max_shard_rows: int,

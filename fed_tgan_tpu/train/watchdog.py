@@ -1,0 +1,201 @@
+"""Training-health watchdog with auto-rollback.
+
+GAN training diverges silently: the offline JSD/WD scores only reveal a
+WGAN-GP blow-up long after the run wasted its budget.  The watchdog
+consumes the signals the trainer already produces — per-round G/D losses
+from the fused epoch program and the similarity scalars
+``train/monitor.py`` computes on snapshot rounds — and raises
+:class:`WatchdogAlarm` on:
+
+- non-finite losses (NaN/Inf) that the update-validation gate did NOT
+  already contain (a quarantined client's losses are excused);
+- loss explosion: any |loss| above ``loss_threshold``;
+- sustained similarity regression: ``similarity_patience`` consecutive
+  monitor reads worse than ``similarity_factor`` x the best seen.
+
+:func:`fit_with_watchdog` turns the alarm into an automatic rollback: it
+reloads the last good checkpoint (``runtime/checkpoint.py``'s
+``find_resumable``), re-anneals the learning rate by ``lr_reanneal``, and
+resumes — at most ``max_rollbacks`` times before aborting cleanly with a
+RuntimeError (never a hang, never a silent garbage model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional
+
+import numpy as np
+
+log = logging.getLogger("fed_tgan_tpu.watchdog")
+
+
+class WatchdogAlarm(RuntimeError):
+    """Training health violated; the driver should roll back or abort."""
+
+
+@dataclasses.dataclass
+class WatchdogConfig:
+    loss_threshold: float = 100.0     # |loss| beyond this = explosion
+    similarity_factor: float = 2.0    # vs best avg_jsd seen so far
+    similarity_patience: int = 3      # consecutive bad monitor reads
+    max_rollbacks: int = 2            # rollbacks before clean abort
+    lr_reanneal: float = 0.5          # lr multiplier on each rollback
+
+
+class TrainingWatchdog:
+    """Stateful health checks; one instance spans rollbacks."""
+
+    def __init__(self, config: WatchdogConfig | None = None):
+        self.cfg = config or WatchdogConfig()
+        self.rollbacks = 0
+        self._best_jsd: float | None = None
+        self._bad_streak = 0
+
+    def reset_window(self) -> None:
+        """Forget in-flight streaks (called after a rollback, NOT the
+        rollback counter — that bounds the whole run)."""
+        self._bad_streak = 0
+
+    # -- trainer hook (FederatedTrainer.fit(health_cb=...)) -----------------
+
+    def health_cb(self, first_round: int, metrics: dict) -> None:
+        """Inspect one chunk's host metric arrays; raise on explosion.
+
+        ``metrics`` maps name -> (rounds, n_clients) arrays; a
+        ``"quarantined"`` entry excuses same-shaped non-finite/huge losses
+        (the gate already contained that client)."""
+        q = None
+        if "quarantined" in metrics:
+            q = np.asarray(metrics["quarantined"]) > 0
+        for name, leaf in metrics.items():
+            if name == "quarantined":
+                continue
+            arr = np.asarray(leaf)
+            bad = ~np.isfinite(arr) | (np.abs(arr) > self.cfg.loss_threshold)
+            if q is not None and bad.shape == q.shape:
+                bad = bad & ~q
+            if bad.any():
+                r = first_round + int(
+                    np.argmax(bad.reshape(arr.shape[0], -1).any(axis=1))
+                )
+                raise WatchdogAlarm(
+                    f"{name} unhealthy at round {r}: "
+                    f"max |{name}|={np.nanmax(np.abs(arr)):.3g}, "
+                    f"finite={bool(np.isfinite(arr).all())} "
+                    f"(threshold {self.cfg.loss_threshold})"
+                )
+
+    # -- monitor hook --------------------------------------------------------
+
+    def observe_similarity(self, round_idx: int, avg_jsd: float) -> None:
+        """Feed one similarity-monitor read (lower JSD = better); raise
+        after ``similarity_patience`` consecutive reads worse than
+        ``similarity_factor`` x the best seen."""
+        if not np.isfinite(avg_jsd):
+            raise WatchdogAlarm(
+                f"non-finite similarity score at round {round_idx}"
+            )
+        if self._best_jsd is None or avg_jsd < self._best_jsd:
+            self._best_jsd = float(avg_jsd)
+            self._bad_streak = 0
+            return
+        if avg_jsd > self.cfg.similarity_factor * self._best_jsd:
+            self._bad_streak += 1
+            if self._bad_streak >= self.cfg.similarity_patience:
+                raise WatchdogAlarm(
+                    f"similarity regressed for {self._bad_streak} "
+                    f"consecutive reads (avg_jsd={avg_jsd:.4f} vs best "
+                    f"{self._best_jsd:.4f}, factor "
+                    f"{self.cfg.similarity_factor}) at round {round_idx}"
+                )
+        else:
+            self._bad_streak = 0
+
+
+def fit_with_watchdog(
+    trainer,
+    epochs: int,
+    watchdog: TrainingWatchdog,
+    ckpt_dir: Optional[str],
+    mesh=None,
+    fit_kwargs: Optional[dict] = None,
+    on_rollback: Optional[Callable] = None,
+):
+    """Run ``trainer.fit`` to ``epochs`` total rounds under the watchdog.
+
+    On a :class:`WatchdogAlarm`: reload the newest valid checkpoint under
+    ``ckpt_dir`` (discarding the poisoned in-memory state), multiply the
+    learning rate by ``lr_reanneal`` (a diverging WGAN-GP usually needs a
+    gentler step, not just a retry), and resume.  If the restored run
+    re-alarms within one round, the restored generation itself carried the
+    corruption (published before the explosion surfaced) — the next
+    rollback falls back to the next-older rotation slot (save with
+    ``keep`` > 1 to have one).  Aborts with RuntimeError once
+    ``max_rollbacks`` is exhausted or no checkpoint is available.
+
+    Returns the final trainer — REASSIGN it at the call site; a rollback
+    replaces the instance (``load_federated`` rebuilds from the checkpoint).
+    ``on_rollback(trainer)``, if given, runs after each reload (tests use
+    it to clear the injected fault; production drivers can re-register
+    hooks that captured the old instance).
+    """
+    from fed_tgan_tpu.runtime.checkpoint import list_resumable, load_federated
+
+    fit_kwargs = dict(fit_kwargs or {})
+    fit_kwargs["health_cb"] = watchdog.health_cb
+    target = trainer.completed_epochs + epochs
+    gen_skip = 0            # how many newest generations to skip over
+    restore_round = None    # completed_epochs right after the last restore
+
+    while trainer.completed_epochs < target:
+        try:
+            trainer.fit(target - trainer.completed_epochs, **fit_kwargs)
+        except WatchdogAlarm as alarm:
+            watchdog.rollbacks += 1
+            log.warning("watchdog alarm (%s); rollback %d/%d",
+                        alarm, watchdog.rollbacks,
+                        watchdog.cfg.max_rollbacks)
+            if watchdog.rollbacks > watchdog.cfg.max_rollbacks:
+                raise RuntimeError(
+                    f"aborting: watchdog fired {watchdog.rollbacks} times, "
+                    f"exceeding max_rollbacks="
+                    f"{watchdog.cfg.max_rollbacks} (last: {alarm})"
+                ) from alarm
+            gens = list_resumable(ckpt_dir) if ckpt_dir else []
+            if not gens:
+                raise RuntimeError(
+                    "aborting: watchdog fired but no resumable checkpoint "
+                    f"exists under {ckpt_dir!r} (pass --save-every to make "
+                    "rollback possible)"
+                ) from alarm
+            # a checkpoint published at round E carries any corruption that
+            # happened DURING round E — its explosion only surfaces at E+1.
+            # If the restored run re-alarmed within one round, that
+            # generation is itself poisoned: step to the next-older one.
+            if (restore_round is not None
+                    and trainer.completed_epochs <= restore_round + 1):
+                gen_skip += 1
+            else:
+                gen_skip = 0
+            src = gens[min(gen_skip, len(gens) - 1)]
+            if gen_skip:
+                log.warning(
+                    "watchdog: newest checkpoint re-alarmed immediately; "
+                    "falling back %d generation(s) to %s", gen_skip, src)
+            old_lr = trainer.cfg.lr
+            trainer = load_federated(src, mesh=mesh)
+            trainer.cfg = dataclasses.replace(
+                trainer.cfg, lr=old_lr * watchdog.cfg.lr_reanneal
+            )
+            trainer._epoch_fns = {}  # lr is baked into the compiled programs
+            watchdog.reset_window()
+            restore_round = trainer.completed_epochs
+            log.warning(
+                "rolled back to %s (round %d); lr re-annealed %g -> %g",
+                src, trainer.completed_epochs, old_lr, trainer.cfg.lr,
+            )
+            if on_rollback is not None:
+                on_rollback(trainer)
+    return trainer

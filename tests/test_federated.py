@@ -127,8 +127,9 @@ def test_fused_rounds_bit_identical_to_sequential(fed_init):
     seq = FederatedTrainer(fed_init, config=CFG, mesh=mesh, seed=7)
     fused.fit(epochs=3)  # no hook -> one 3-round program
     seq.fit(epochs=3, max_rounds_per_call=1)
-    assert len(fused._epoch_fns) == 1 and 3 in fused._epoch_fns
-    assert len(seq._epoch_fns) == 1 and 1 in seq._epoch_fns
+    # cache key is (rounds, update_fault); no fault installed here
+    assert len(fused._epoch_fns) == 1 and (3, None) in fused._epoch_fns
+    assert len(seq._epoch_fns) == 1 and (1, None) in seq._epoch_fns
     for a, b in zip(jax.tree.leaves(fused.models), jax.tree.leaves(seq.models)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     np.testing.assert_array_equal(
@@ -146,7 +147,7 @@ def test_sparse_hook_epochs_fuse_and_fire(fed_init):
     assert tr.completed_epochs == 5
     assert len(tr.epoch_times) == 5
     # chunks: [0], [1..3], [4] -> programs for sizes 1 and 3
-    assert set(tr._epoch_fns) == {1, 3}
+    assert set(tr._epoch_fns) == {(1, None), (3, None)}
     # hook time lands on the firing rounds only
     assert tr.phase_times["distribution"][1] == 0.0
     assert tr.phase_times["distribution"][4] == 0.0

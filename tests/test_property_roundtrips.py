@@ -8,6 +8,9 @@ example-based tests use.
 
 import numpy as np
 import pandas as pd
+import pytest
+
+pytest.importorskip("hypothesis")  # optional dev dep; absent on slim CI boxes
 from hypothesis import given, settings, strategies as st
 
 from fed_tgan_tpu.data.dates import join_date_columns, split_date_columns

@@ -1,0 +1,392 @@
+"""Byzantine-tolerant aggregation + training-health watchdog.
+
+Covers the robustness layer end to end: fault-spec parsing, the
+update-validation gate (NaN screen + median-norm outlier test), in-graph vs
+host-side aggregator parity, quarantine -> strike -> eviction, the
+scaling-attack degradation contract (trimmed/median stay near fault-free
+while plain weighted demonstrably degrades), and the watchdog's
+auto-rollback / bounded-abort behavior.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.parallel.fedavg import (
+    host_robust_aggregate,
+    host_weighted_average,
+    robust_aggregate,
+)
+from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS, client_mesh, shard_map
+from fed_tgan_tpu.runtime.checkpoint import save_federated
+from fed_tgan_tpu.testing.faults import (
+    FaultPlan,
+    install_plan,
+    update_fault_window,
+)
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+from fed_tgan_tpu.train.watchdog import (
+    TrainingWatchdog,
+    WatchdogAlarm,
+    WatchdogConfig,
+    fit_with_watchdog,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    install_plan(None)
+    yield
+    install_plan(None)
+
+
+@pytest.fixture(scope="module")
+def fed_init3(toy_frame, toy_spec):
+    frames = shard_dataframe(toy_frame, 3, "iid", seed=9)
+    clients = [TablePreprocessor(frame=f, **toy_spec) for f in frames]
+    return federated_initialize(clients, seed=0)
+
+
+def _cfg(**kw):
+    return TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                       batch_size=40, pac=4, **kw)
+
+
+# -- fault-spec parsing ------------------------------------------------------
+
+
+def test_parse_update_faults():
+    p = FaultPlan.parse("nan_update:rank=3,round=2,until=5")
+    assert (p.update_kind, p.update_rank, p.update_round, p.update_until) == (
+        "nan", 3, 2, 5)
+
+    p = FaultPlan.parse("scale_update:100")  # positional factor, rank=1
+    assert (p.update_kind, p.update_rank, p.update_factor) == ("scale", 1, 100.0)
+
+    p = FaultPlan.parse("scale_update:factor=1e6,rank=2")
+    assert (p.update_kind, p.update_rank, p.update_factor) == ("scale", 2, 1e6)
+
+    p = FaultPlan.parse("stuck_update:rank=2;delay_msg:ms=1")
+    assert p.update_kind == "stuck" and p.delay_ms == 1
+
+
+def test_parse_unknown_kind_fails_fast():
+    with pytest.raises(ValueError) as e:
+        FaultPlan.parse("nan_updat:rank=1")  # typo must not silently no-op
+    msg = str(e.value)
+    assert "nan_updat" in msg
+    for kind in FaultPlan.VALID_KINDS:
+        assert kind in msg  # error lists every valid kind
+
+
+def test_update_fault_window_clips_chunks():
+    # no plan: chunk passes through untouched
+    assert update_fault_window(None, 0, 16) == (None, 16)
+    plan = FaultPlan.parse("scale_update:factor=2,rank=1,round=3,until=4")
+    # rounds are 1-based in the spec, 0-based here: active window is [2, 3]
+    assert update_fault_window(plan, 0, 16) == (None, 2)      # clip at start
+    assert update_fault_window(plan, 2, 16) == (("scale", 0, 2.0), 2)
+    assert update_fault_window(plan, 4, 16) == (None, 16)     # past the window
+    forever = FaultPlan.parse("nan_update:rank=2,round=2")
+    assert update_fault_window(forever, 0, 8) == (None, 1)
+    assert update_fault_window(forever, 1, 8) == (("nan", 1, 1.0), 8)
+
+
+# -- aggregator parity: in-graph vs host-side --------------------------------
+
+
+def _toy_trees(n=4, seed=0, poison=None):
+    """(prev, new_trees): n clients around a common prev with small deltas;
+    ``poison`` optionally corrupts the LAST client ('nan' or a scale)."""
+    rng = np.random.default_rng(seed)
+    prev = {"w": rng.normal(size=(3, 2)).astype(np.float32),
+            "b": rng.normal(size=(3,)).astype(np.float32),
+            "step": np.int32(7)}  # non-float leaf must pass through untouched
+    news = []
+    for i in range(n):
+        d = {k: rng.normal(scale=0.1, size=np.shape(v)).astype(np.float32)
+             for k, v in prev.items() if k != "step"}
+        new = {"w": prev["w"] + d["w"], "b": prev["b"] + d["b"],
+               "step": prev["step"]}
+        if poison is not None and i == n - 1:
+            if poison == "nan":
+                new = {"w": np.full_like(prev["w"], np.nan),
+                       "b": np.full_like(prev["b"], np.nan),
+                       "step": prev["step"]}
+            else:
+                new = {"w": prev["w"] + poison * d["w"],
+                       "b": prev["b"] + poison * d["b"], "step": prev["step"]}
+        news.append(new)
+    return prev, news
+
+
+@pytest.mark.parametrize("aggregator", ["weighted", "clipped", "trimmed",
+                                        "median"])
+@pytest.mark.parametrize("poison", [None, "nan", 1000.0])
+def test_ingraph_matches_host(aggregator, poison):
+    n = 4
+    prev, news = _toy_trees(n=n, seed=3, poison=poison)
+    weights = np.asarray([0.3, 0.3, 0.2, 0.2], dtype=np.float32)
+    steps = np.ones(n, dtype=np.int32)
+    kw = dict(aggregator=aggregator, update_gate=True, trim_ratio=0.3)
+
+    host_agg, host_q = host_robust_aggregate(prev, news, weights, steps, **kw)
+
+    # device side: stack clients along a leading axis, shard over the mesh
+    mesh = client_mesh(n)
+    prev_s = jax.tree.map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x)[None],
+                                   (n,) + np.shape(x)), prev)
+    new_s = jax.tree.map(lambda *xs: jnp.stack(xs), *news)
+
+    def f(p, nw, w, s):
+        return robust_aggregate(p, nw, w, s, k=1, **kw)
+
+    fn = shard_map(
+        f, mesh=mesh,
+        in_specs=(P(CLIENTS_AXIS), P(CLIENTS_AXIS), P(CLIENTS_AXIS),
+                  P(CLIENTS_AXIS)),
+        out_specs=(P(), P(CLIENTS_AXIS)),
+        check_vma=False,
+    )
+    dev_agg, dev_q = jax.jit(fn)(prev_s, new_s, jnp.asarray(weights),
+                                 jnp.asarray(steps))
+
+    np.testing.assert_array_equal(np.asarray(dev_q) > 0.5, np.asarray(host_q))
+    if poison is not None:
+        assert np.asarray(host_q)[-1] and not np.asarray(host_q)[:-1].any()
+    for hk, hv in host_agg.items():
+        np.testing.assert_allclose(np.asarray(dev_agg[hk]), hv, atol=1e-5,
+                                   err_msg=hk)
+        assert np.isfinite(np.asarray(dev_agg[hk], dtype=np.float64)).all()
+
+
+def test_clean_weighted_passthrough_is_exact():
+    """On a clean round the gate must be a no-op: the robust 'weighted'
+    path reproduces the plain weighted average with the ORIGINAL weights
+    (the scalar select keeps clean trajectories byte-identical)."""
+    prev, news = _toy_trees(n=4, seed=5)
+    weights = np.asarray([0.4, 0.3, 0.2, 0.1], dtype=np.float32)
+    agg, quar = host_robust_aggregate(prev, news, weights,
+                                      np.ones(4, dtype=np.int32))
+    plain = host_weighted_average(news, weights)
+    assert not quar.any()
+    for k in ("w", "b"):
+        np.testing.assert_array_equal(agg[k], plain[k])
+
+
+def test_gate_renormalizes_weights_over_survivors():
+    prev, news = _toy_trees(n=4, seed=1, poison="nan")
+    weights = np.asarray([0.4, 0.3, 0.2, 0.1])
+    agg, quar = host_robust_aggregate(prev, news, weights,
+                                      np.ones(4, dtype=np.int32))
+    assert list(quar) == [False, False, False, True]
+    w_surv = np.asarray([0.4, 0.3, 0.2]) / 0.9
+    expect = host_weighted_average(news[:3], w_surv)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(agg[k], expect[k], atol=1e-6)
+
+
+def test_low_norm_side_catches_stuck_client():
+    """A client replaying stale params (zero delta) trips the LOW side of
+    the two-sided norm test."""
+    prev, news = _toy_trees(n=4, seed=2)
+    news[-1] = {k: (np.copy(v) if isinstance(v, np.ndarray) else v)
+                for k, v in prev.items()}  # stuck: new == prev exactly
+    _, quar = host_robust_aggregate(prev, news, np.full(4, 0.25),
+                                    np.ones(4, dtype=np.int32))
+    assert list(quar) == [False, False, False, True]
+
+
+# -- trainer integration: quarantine, strikes, eviction ----------------------
+
+
+def test_nan_update_quarantine_and_eviction(fed_init3):
+    install_plan(FaultPlan.parse("nan_update:rank=3"))
+    tr = FederatedTrainer(fed_init3, config=_cfg(), mesh=client_mesh(3),
+                          seed=0, min_clients=1, quarantine_strikes=2)
+    tr.fit(epochs=4)
+    assert tr.completed_epochs == 4
+    # the faulty client was quarantined every round, struck out, and evicted
+    assert tr.dropped_clients == {2}
+    assert tr.weights[2] == 0.0
+    np.testing.assert_allclose(tr.weights.sum(), 1.0, atol=1e-5)
+    # the global model never absorbed a NaN
+    for leaf in jax.tree.leaves(tr.models.params_g):
+        assert np.isfinite(np.asarray(leaf)).all()
+    out = tr.sample(60, seed=1)
+    assert np.isfinite(out).all()
+
+
+def test_scaling_attack_degradation(fed_init3, toy_frame):
+    """ISSUE acceptance: under scale_update:100 the robust aggregators stay
+    within 2x of the fault-free similarity while plain weighted (gate off)
+    demonstrably degrades.  Gate OFF isolates the aggregator itself; 3
+    clients need trim_ratio >= 0.34 so the trimmed mean actually trims."""
+    import dataclasses
+
+    from fed_tgan_tpu.train.monitor import SimilarityMonitor
+
+    base = _cfg(update_gate=False, trim_ratio=0.34)
+    mon = SimilarityMonitor(fed_init3.global_meta, fed_init3.encoders,
+                            toy_frame, n_rows=300, seed=0)
+
+    def run(aggregator, fault):
+        install_plan(FaultPlan.parse(fault) if fault else None)
+        cfg = dataclasses.replace(base, aggregator=aggregator)
+        tr = FederatedTrainer(fed_init3, config=cfg, mesh=client_mesh(3),
+                              seed=0)
+        tr.fit(epochs=3, on_nonfinite="ignore")
+        install_plan(None)
+        out = mon.evaluate(tr, seed=5)
+        return out["avg_jsd"], out["avg_wd"]
+
+    jsd_clean, wd_clean = run("weighted", "")
+    jsd_bad, wd_bad = run("weighted", "scale_update:100")
+    assert np.isfinite(jsd_clean) and np.isfinite(wd_clean)
+    # plain weighted absorbs the poisoned delta: similarity demonstrably
+    # worse (or outright non-finite) than the fault-free run
+    weighted_degraded = (not np.isfinite(wd_bad)) or (
+        jsd_bad > 1.25 * jsd_clean) or (wd_bad > 2.0 * wd_clean)
+    assert weighted_degraded, (jsd_clean, jsd_bad, wd_clean, wd_bad)
+
+    for robust in ("trimmed", "median"):
+        jsd_r, wd_r = run(robust, "scale_update:100")
+        assert np.isfinite(jsd_r) and np.isfinite(wd_r), robust
+        assert jsd_r <= 2.0 * jsd_clean, (robust, jsd_r, jsd_clean)
+        assert wd_r <= 2.0 * wd_clean + 0.05, (robust, wd_r, wd_clean)
+        # and strictly better than the poisoned plain-weighted run
+        assert (not np.isfinite(jsd_bad)) or jsd_r < jsd_bad
+
+
+# -- watchdog: alarm, rollback, bounded abort --------------------------------
+
+
+def test_watchdog_unit_alarms():
+    wd = TrainingWatchdog(WatchdogConfig(loss_threshold=10.0,
+                                         similarity_patience=2))
+    # finite, small: fine
+    wd.health_cb(0, {"loss_g": np.zeros((2, 3)), "loss_d": np.ones((2, 3))})
+    with pytest.raises(WatchdogAlarm, match="round 1"):
+        wd.health_cb(0, {"loss_d": np.array([[1.0, 1.0], [np.inf, 1.0]])})
+    # a quarantined client's garbage is excused
+    wd.health_cb(0, {"loss_d": np.array([[1.0, np.nan]]),
+                     "quarantined": np.array([[0.0, 1.0]])})
+    # similarity regression: patience consecutive reads over factor x best
+    wd.observe_similarity(0, 0.10)
+    wd.observe_similarity(1, 0.25)
+    with pytest.raises(WatchdogAlarm, match="regressed"):
+        wd.observe_similarity(2, 0.25)
+
+
+def _saver(ckpt):
+    def hook(e, trainer):
+        save_federated(trainer, ckpt, run_name="toy")
+    return hook
+
+
+def test_watchdog_rolls_back_and_reanneals(fed_init3, tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    mesh = client_mesh(3)
+    tr = FederatedTrainer(fed_init3, config=_cfg(update_gate=False),
+                          mesh=mesh, seed=0)
+    base_lr = tr.cfg.lr
+    # one poisoned round (round 2); the explosion surfaces in round 3's
+    # losses, after the round-1 checkpoint exists
+    install_plan(FaultPlan.parse("scale_update:factor=1e6,rank=1,round=2,until=2"))
+    wd = TrainingWatchdog(WatchdogConfig(loss_threshold=50.0, max_rollbacks=2))
+    tr = fit_with_watchdog(
+        tr, 4, wd, ckpt, mesh=mesh,
+        fit_kwargs=dict(sample_hook=_saver(ckpt), hook_epochs=[0]),
+        on_rollback=lambda t: install_plan(None),  # operator fixed the cause
+    )
+    assert wd.rollbacks == 1
+    assert tr.completed_epochs == 4
+    assert tr.cfg.lr == pytest.approx(base_lr * wd.cfg.lr_reanneal)
+    for leaf in jax.tree.leaves(tr.models.params_g):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_watchdog_falls_back_to_older_generation(fed_init3, tmp_path):
+    """A checkpoint published the same round the corruption happened is
+    itself poisoned (the explosion only surfaces one round later).  When
+    the restored run re-alarms immediately, the watchdog must step back to
+    the next-older rotation slot instead of replaying the bad state."""
+    ckpt = str(tmp_path / "ckpt")
+    mesh = client_mesh(3)
+    tr = FederatedTrainer(fed_init3, config=_cfg(update_gate=False),
+                          mesh=mesh, seed=0)
+
+    def saver(e, trainer):  # every round, two generations retained
+        save_federated(trainer, ckpt, run_name="toy", keep=2)
+
+    # poison lands after round 3's training: round-3 checkpoint (the
+    # newest) holds poisoned params, round-2 (rotated to .1) is clean
+    install_plan(FaultPlan.parse("scale_update:factor=1e6,rank=1,round=3,until=3"))
+    wd = TrainingWatchdog(WatchdogConfig(loss_threshold=50.0, max_rollbacks=2))
+    tr = fit_with_watchdog(
+        tr, 4, wd, ckpt, mesh=mesh, fit_kwargs=dict(sample_hook=saver),
+        on_rollback=lambda t: install_plan(None))
+    # rollback 1 restored the poisoned primary and re-alarmed; rollback 2
+    # fell back to the clean .1 generation and the run completed
+    assert wd.rollbacks == 2
+    assert tr.completed_epochs == 4
+    for leaf in jax.tree.leaves(tr.models.params_g):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_watchdog_bounded_abort(fed_init3, tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    mesh = client_mesh(3)
+    tr = FederatedTrainer(fed_init3, config=_cfg(update_gate=False),
+                          mesh=mesh, seed=0)
+    # persistent fault: every replay re-explodes until the budget runs out
+    install_plan(FaultPlan.parse("scale_update:factor=1e6,rank=1,round=2"))
+    wd = TrainingWatchdog(WatchdogConfig(loss_threshold=50.0, max_rollbacks=1))
+    with pytest.raises(RuntimeError, match="max_rollbacks"):
+        fit_with_watchdog(
+            tr, 4, wd, ckpt, mesh=mesh,
+            fit_kwargs=dict(sample_hook=_saver(ckpt), hook_epochs=[0]))
+    assert wd.rollbacks == wd.cfg.max_rollbacks + 1
+
+
+def test_watchdog_aborts_without_checkpoint(fed_init3):
+    tr = FederatedTrainer(fed_init3, config=_cfg(update_gate=False),
+                          mesh=client_mesh(3), seed=0)
+    install_plan(FaultPlan.parse("scale_update:factor=1e6,rank=1,round=1"))
+    wd = TrainingWatchdog(WatchdogConfig(loss_threshold=50.0))
+    with pytest.raises(RuntimeError, match="no resumable checkpoint"):
+        fit_with_watchdog(tr, 3, wd, None)
+
+
+# -- soak runner smoke -------------------------------------------------------
+
+
+def test_soak_runner_smoke(toy_frame, tmp_path, monkeypatch):
+    """scripts/soak.py completes (or aborts CLEANLY) under a seeded random
+    fault plan; any other exception type is a real bug."""
+    import importlib.util
+    import sys
+
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                        "soak.py")
+    spec = importlib.util.spec_from_file_location("soak", path)
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+
+    out = soak.run_soak(seed=0, epochs=2, n_clients=3, rows=240)
+    assert out["outcome"] in ("completed", "aborted")
+    assert out["faults"]  # a plan was actually installed
+    if out["outcome"] == "completed":
+        assert out["finite_params"]

@@ -132,6 +132,37 @@ def test_bench_scale_workload_small():
     assert "covertype_scale_8client_4800row" in out["metric"]
 
 
+def _intrusion_like(n=400, seed=0):
+    """Deterministic stand-in for the reference Intrusion CSV: same 42
+    selected columns and categorical/continuous split as the INTRUSION
+    preset, so bench._setup runs without the dataset on disk."""
+    import pandas as pd
+
+    from fed_tgan_tpu.datasets import INTRUSION
+
+    rng = np.random.default_rng(seed)
+    cats = set(INTRUSION.categorical_columns)
+    vocab = {
+        "protocol_type": ["tcp", "udp", "icmp"],
+        "service": ["http", "smtp", "ftp", "dns"],
+        "flag": ["SF", "S0", "REJ"],
+        "class": ["normal", "anomaly"],
+    }
+    cols = {}
+    for name in INTRUSION.selected_columns:
+        if name in cats:
+            values = vocab.get(name, ["0", "1"])
+            p = None if name in vocab else [0.9, 0.1]
+            cols[name] = rng.choice(values, n, p=p)
+        elif name in ("src_bytes", "dst_bytes", "duration"):
+            cols[name] = np.exp(rng.normal(4.0, 2.0, n)).round(0)
+        elif name.endswith("_rate"):
+            cols[name] = rng.uniform(0.0, 1.0, n).round(2)
+        else:  # count-style columns
+            cols[name] = rng.integers(0, 256, n).astype(float)
+    return pd.DataFrame(cols)
+
+
 def test_bench_setup_batch_size_raises_step_budget():
     """`bench.py --workload utility --batch-size N` is the small-sample
     lever for the 500-epoch ΔF1 horizon: an epoch is rows//batch steps per
@@ -139,11 +170,13 @@ def test_bench_setup_batch_size_raises_step_budget():
     smaller batch trains more steps at the same epoch count.  Verify the
     flag reaches TrainConfig and the per-client step budget scales."""
     import importlib
+    import os
 
     import pandas as pd
 
     bench = importlib.import_module("bench")
-    df = pd.read_csv(bench.CSV_PATH).head(400)
+    df = (pd.read_csv(bench.CSV_PATH).head(400)
+          if os.path.exists(bench.CSV_PATH) else _intrusion_like(400))
     _, init, t100 = bench._setup(df=df, batch_size=100)
     t50 = FederatedTrainer(init, config=TrainConfig(batch_size=50), seed=0)
     assert t100.cfg.batch_size == 100 and t50.cfg.batch_size == 50
