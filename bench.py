@@ -1066,12 +1066,111 @@ def bench_multihost(epochs: int = 10) -> dict:
     }
 
 
+def bench_serving(duration_s: float = 15.0, clients: int = 4,
+                  rows_per_request: int = 200, seed: int = 0) -> dict:
+    """Serving throughput/latency: concurrent clients against an in-process
+    ``serve.SamplingService`` over a demo artifact.
+
+    Measures sustained rows/sec and client-observed p50/p99 latency over a
+    fixed wall-clock window (warm-up request first, so the one-time XLA
+    compile never pollutes the numbers), plus the service's own
+    batch-occupancy counter — the micro-batching proof: > 1 means the
+    worker really coalesced concurrent requests into shared cycles."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from fed_tgan_tpu.serve.demo import build_demo_artifact
+    from fed_tgan_tpu.serve.registry import ModelRegistry
+    from fed_tgan_tpu.serve.service import SamplingService
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_bench_serving_")
+    svc = None
+    try:
+        build_demo_artifact(tmp, rows=400, epochs=1, seed=seed)
+        svc = SamplingService(
+            ModelRegistry(tmp, log=lambda *a: None), port=0,
+            max_batch=8, queue_size=256, log=lambda *a: None,
+        ).start()
+        url = svc.url
+        with urllib.request.urlopen(
+                f"{url}/sample?rows={rows_per_request}&seed=0",
+                timeout=300) as r:
+            r.read()  # warm-up: compile the request bucket off the clock
+
+        lock = threading.Lock()
+        latencies: list = []
+        rows_done = [0]
+        shed = [0]
+        t_end = time.time() + duration_s
+
+        def client(idx: int) -> None:
+            i = 0
+            while time.time() < t_end:
+                t0 = time.time()
+                try:
+                    with urllib.request.urlopen(
+                            f"{url}/sample?rows={rows_per_request}"
+                            f"&seed={idx}&offset={i * rows_per_request}",
+                            timeout=120) as r:
+                        r.read()
+                except urllib.error.HTTPError as exc:
+                    if exc.code == 503:  # load shed: back off and retry
+                        with lock:
+                            shed[0] += 1
+                        continue
+                    raise
+                with lock:
+                    latencies.append(time.time() - t0)
+                    rows_done[0] += rows_per_request
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t_start = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.time() - t_start
+        snap = svc.metrics.snapshot(svc.queue_depth())
+        lat = sorted(latencies)
+
+        def pct(q: float) -> float:
+            return lat[min(len(lat) - 1, int(q * len(lat)))] if lat else 0.0
+
+        return {
+            "metric": "bench_serving",
+            "value": round(rows_done[0] / max(elapsed, 1e-9), 1),
+            "unit": "rows/s served",
+            "vs_baseline": 0,
+            "clients": clients,
+            "rows_per_request": rows_per_request,
+            "requests": len(latencies),
+            "duration_s": round(elapsed, 2),
+            "p50_ms": round(pct(0.50) * 1e3, 2),
+            "p99_ms": round(pct(0.99) * 1e3, 2),
+            "batch_occupancy": snap["batch_occupancy"],
+            "shed_retries": shed[0],
+            "server_errors": snap["errors_total"],
+        }
+    finally:
+        if svc is not None:
+            try:
+                svc.shutdown(drain=False)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     global CSV_PATH
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload",
                     choices=["round", "full500", "utility", "multihost",
-                             "scale", "adult"],
+                             "scale", "adult", "serving"],
                     default="round")
     ap.add_argument("--rows", type=int, default=None,
                     help="scale/adult workloads: synthetic table row count "
@@ -1174,9 +1273,10 @@ def main() -> int:
     args = ap.parse_args()
     if args.csv:
         CSV_PATH = args.csv
-    # scale generates its own synthetic Covertype-like table and never
-    # reads the Intrusion CSV — don't require it there
-    if args.workload not in ("scale", "adult") \
+    # scale generates its own synthetic Covertype-like table and serving
+    # trains its own demo artifact — neither reads the Intrusion CSV, so
+    # don't require it there
+    if args.workload not in ("scale", "adult", "serving") \
             and not os.path.exists(CSV_PATH):
         ap.error(f"Intrusion CSV not found at {CSV_PATH}; point --csv or "
                  "FED_TGAN_BENCH_CSV at a copy")
@@ -1216,7 +1316,7 @@ def main() -> int:
     bgm = args.bgm_backend or (
         "jax" if args.workload == "scale" else "sklearn")
     clients = args.clients if args.clients is not None else {
-        "scale": 32, "adult": 8
+        "scale": 32, "adult": 8, "serving": 4
     }.get(args.workload, 2)
     # multihost is CPU-gloo by construction: no accelerator probe, no tag
     if args.backend == "cpu":
@@ -1237,7 +1337,7 @@ def main() -> int:
                      ".bench_jax_cache")
     )
     epochs = args.epochs if args.epochs is not None else {
-        "multihost": 10, "scale": 50
+        "multihost": 10, "scale": 50, "serving": 1
     }.get(args.workload, 500)
     rows = args.rows if args.rows is not None else (
         48_842 if args.workload == "adult" else 580_000)
@@ -1339,6 +1439,8 @@ def _is_backend_unavailable(exc: BaseException) -> bool:
 
 
 def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
+    if args.workload == "serving":
+        return bench_serving(clients=clients)
     if args.workload == "round":
         return bench_round(bgm_backend=bgm,
                            profile_dir=args.profile_dir)

@@ -192,6 +192,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "run's --out-dir, its models/ dir, or the synthesizer "
                         "dir) and write --sample-rows decoded rows to "
                         "<out-dir>/<name>_synthesis_sampled.csv")
+    p.add_argument("--allow-meta-mismatch", action="store_true",
+                   help="--sample-from: proceed even when the meta JSON is "
+                        "newer than the saved synthesizer (a crashed later "
+                        "run's signature — normally a hard error, because "
+                        "decoding through mismatched artifacts produces "
+                        "wrong categories or shape failures)")
     p.add_argument("--eval", action="store_true",
                    help="run similarity analysis against the training data at the end")
     p.add_argument("--decode", choices=["exact", "packed16", "packed8"],
@@ -535,6 +541,14 @@ def _enable_compile_cache() -> None:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # subcommand dispatch ahead of the flag parser: every reference-compat
+    # flag starts with "-", so a bare leading word is unambiguous
+    if argv and argv[0] in ("serve", "sample-client"):
+        from fed_tgan_tpu.serve.service import client_main, serve_main
+
+        return (serve_main if argv[0] == "serve" else client_main)(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
 
@@ -719,85 +733,27 @@ def _run_sample_from(args) -> int:
     """Sampling-only mode: regenerate synthetic rows from a persisted
     ``--save-model`` artifact without retraining — the workflow the
     reference's never-called ``save_model`` (Server/dtds/distributed.py:560)
-    was meant for."""
-    import glob
-
+    was meant for.  Artifact discovery and generation both go through the
+    serving layer (``serve.registry`` + ``serve.engine``), so this one-shot
+    path and a ``serve`` instance produce byte-identical rows for the same
+    (rows, seed)."""
     from fed_tgan_tpu.data.csvio import write_csv
-    from fed_tgan_tpu.data.decode import decode_matrix
-    from fed_tgan_tpu.data.schema import TableMeta
-    from fed_tgan_tpu.runtime.checkpoint import load_synthesizer
+    from fed_tgan_tpu.serve import engine as serve_engine
+    from fed_tgan_tpu.serve import registry as serve_registry
 
-    root = os.path.abspath(args.sample_from)
-    candidates = [os.path.join(root, "models"), root, os.path.dirname(root)]
-    models_dir = synth_dir = meta_path = None
-    for cand in candidates:
-        synth = os.path.join(cand, "synthesizer")
-        # a meta JSON counts only with its paired encoder pickle (the two
-        # decode artifacts are written together)
-        metas = [
-            m for m in sorted(glob.glob(os.path.join(cand, "*.json")))
-            if os.path.exists(os.path.join(
-                cand,
-                "label_encoders_"
-                f"{os.path.splitext(os.path.basename(m))[0]}.pickle",
-            ))
-        ]
-        if os.path.isdir(synth) and metas:
-            if len(metas) > 1:
-                # several runs share this models dir; the synthesizer dir
-                # holds only the LAST-saved artifact, so take the newest
-                # meta (written in the same run) and say so
-                metas.sort(key=os.path.getmtime)
-                print(
-                    "--sample-from: multiple run artifacts in "
-                    f"{cand} ({[os.path.basename(m) for m in metas]}); "
-                    f"using the newest: {os.path.basename(metas[-1])}"
-                )
-            models_dir, synth_dir, meta_path = cand, synth, metas[-1]
-            break
-    if models_dir is None:
-        print(
-            f"--sample-from: no synthesizer artifact + meta JSON/encoder "
-            f"pair found under any of {candidates} (train once with "
-            "--save-model first)"
-        )
+    try:
+        art = serve_registry.resolve_artifact(args.sample_from)
+        serve_registry.check_meta_freshness(
+            art, allow=getattr(args, "allow_meta_mismatch", False))
+        model = serve_registry.load_model(art)
+    except serve_registry.ArtifactError as exc:
+        print(f"--sample-from: {exc}")
         return 2
 
-    name = os.path.splitext(os.path.basename(meta_path))[0]
-    enc_path = os.path.join(models_dir, f"label_encoders_{name}.pickle")
-
-    # meta/encoders are written at training START, the synthesizer at the
-    # END — a later run that crashed (or omitted --save-model) leaves the
-    # newest meta paired with an OLDER run's synthesizer.  Decoding through
-    # mismatched artifacts produces wrong categories or a shape error, so
-    # detect the inversion and say what it means before sampling.
-    try:
-        synth_mtime = max(
-            os.path.getmtime(os.path.join(synth_dir, f))
-            for f in os.listdir(synth_dir)
-        )
-        if os.path.getmtime(meta_path) > synth_mtime:
-            print(
-                "--sample-from WARNING: meta "
-                f"{os.path.basename(meta_path)} is newer than the saved "
-                "synthesizer — the run that wrote it likely never saved a "
-                "model (crashed or ran without --save-model).  Sampling "
-                "proceeds with the OLDER synthesizer; if the schema "
-                "changed between runs this will decode wrong categories "
-                "or fail on shapes."
-            )
-    except (OSError, ValueError):
-        pass  # unreadable/empty synth dir: load_synthesizer will explain
-
-    synth = load_synthesizer(synth_dir)
-    meta = TableMeta.load_json(meta_path)
-    with open(enc_path, "rb") as f:
-        encoders = [d["label_encoder"] for d in pickle.load(f)]
-
-    decoded = synth.sample(args.sample_rows, seed=args.seed)
-    raw = decode_matrix(decoded, meta, encoders)
+    engine = serve_engine.SamplingEngine(model)
+    raw = engine.sample_frame(args.sample_rows, seed=args.seed)
     os.makedirs(args.out_dir, exist_ok=True)
-    out_csv = os.path.join(args.out_dir, f"{name}_synthesis_sampled.csv")
+    out_csv = os.path.join(args.out_dir, f"{art.name}_synthesis_sampled.csv")
     write_csv(raw, out_csv)
     if not args.quiet:
         print(f"wrote {len(raw)} rows to {out_csv}")
@@ -848,9 +804,10 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
 
         models_dir = os.path.join(args.out_dir, "models")
         os.makedirs(models_dir, exist_ok=True)
-        save_synthesizer(synth, os.path.join(models_dir, "synthesizer"))
         # the decode artifacts --sample-from needs (the federated path
-        # always writes these; keep the layouts identical)
+        # always writes these; keep the layouts identical).  Meta/encoders
+        # first, the synthesizer LAST — the registry's meta-freshness check
+        # reads a meta newer than the synthesizer as a crashed later run
         table_meta.dump_json(os.path.join(models_dir, f"{name}.json"))
         with open(
             os.path.join(models_dir, f"label_encoders_{name}.pickle"), "wb"
@@ -858,6 +815,7 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
             pickle.dump(
                 encoder_artifact(table_meta.categorical_columns, encoders), f
             )
+        save_synthesizer(synth, os.path.join(models_dir, "synthesizer"))
 
     if args.eval:
         from fed_tgan_tpu.eval.similarity import statistical_similarity

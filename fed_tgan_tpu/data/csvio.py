@@ -71,3 +71,27 @@ def write_csv(df: pd.DataFrame, path: str) -> None:
         df.to_csv(path, index=False)
         return
     pacsv.write_csv(table, path)
+
+
+def csv_bytes(df: pd.DataFrame) -> bytes:
+    """``write_csv``'s exact output as bytes (same routing, same writer).
+
+    The serving layer returns these directly, so a served response is
+    byte-identical to the file the one-shot ``--sample-from`` path writes
+    for the same frame."""
+    import io
+
+    try:
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+    except ImportError:
+        return df.to_csv(index=False).encode()
+    if not _arrow_friendly(df):
+        return df.to_csv(index=False).encode()
+    try:
+        table = pa.Table.from_pandas(df, preserve_index=False)
+    except (pa.ArrowInvalid, pa.ArrowTypeError):
+        return df.to_csv(index=False).encode()
+    buf = io.BytesIO()
+    pacsv.write_csv(table, buf)
+    return buf.getvalue()

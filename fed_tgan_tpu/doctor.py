@@ -12,7 +12,10 @@ so "why does my launch hang" is answered in seconds:
 3. the virtual multi-device CPU mesh + a collective (the tests/CI path,
    and proof the SPMD program model works on this host without chips);
 4. the native TCP transport (C++ layer) via a localhost loopback;
-5. the persistent compile cache location and machine fingerprint.
+5. the persistent compile cache location and machine fingerprint;
+6. the serving subsystem: a demo artifact trained, served over HTTP on an
+   ephemeral port, and byte-compared against the one-shot --sample-from
+   path (decode parity).
 
 Exit code 0 when every check passes, 1 otherwise.  Read-only except for
 the loopback socket and (if missing) the cache directory.
@@ -218,6 +221,68 @@ def check_robust_aggregation() -> bool:
                  "clean mean")
 
 
+def check_serving() -> bool:
+    """The serving subsystem round-trips the demo table with decode parity.
+
+    Builds a tiny --save-model artifact, serves it on an ephemeral port,
+    fetches rows over HTTP, and verifies the response bytes are identical
+    to what the one-shot ``--sample-from`` path (a FRESH engine, so this
+    also proves the compiled path is seed-deterministic across engines)
+    writes for the same (rows, seed)."""
+    import json
+    import shutil
+    import tempfile
+    import urllib.request
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_doctor_serve_")
+    svc = None
+    try:
+        from types import SimpleNamespace
+
+        from fed_tgan_tpu import cli
+        from fed_tgan_tpu.serve.demo import build_demo_artifact
+        from fed_tgan_tpu.serve.registry import ModelRegistry
+        from fed_tgan_tpu.serve.service import SamplingService
+
+        build_demo_artifact(tmp, rows=200, epochs=1)
+        svc = SamplingService(ModelRegistry(tmp, log=lambda *a: None),
+                              port=0, log=lambda *a: None).start()
+        with urllib.request.urlopen(f"{svc.url}/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        if health.get("status") != "ok":
+            return _line(False, "serving", f"healthz said {health}")
+        with urllib.request.urlopen(
+                f"{svc.url}/sample?rows=40&seed=7", timeout=120) as r:
+            served = r.read()
+        out_dir = os.path.join(tmp, "oneshot")
+        rc = cli._run_sample_from(SimpleNamespace(
+            sample_from=tmp, sample_rows=40, seed=7, out_dir=out_dir,
+            quiet=True, allow_meta_mismatch=False))
+        if rc != 0:
+            return _line(False, "serving", f"--sample-from path rc={rc}")
+        with open(os.path.join(out_dir, "demo_synthesis_sampled.csv"),
+                  "rb") as f:
+            oneshot = f.read()
+        if served != oneshot:
+            return _line(False, "serving",
+                         "served bytes differ from the one-shot "
+                         f"--sample-from CSV ({len(served)} vs "
+                         f"{len(oneshot)} bytes)")
+        return _line(True, "serving",
+                     f"model {health['model_id']} served 40 rows on "
+                     f"{svc.url}; response byte-identical to the one-shot "
+                     "--sample-from path")
+    except Exception as exc:
+        return _line(False, "serving", f"{exc!r}")
+    finally:
+        if svc is not None:
+            try:
+                svc.shutdown(drain=False)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def wait_healthy(timeout_min: float = 0.0, quiet_min: float = 45.0,
                  probe_timeout_s: int = 120,
                  _probe=None, _load=None, _sleep=None, _log=print) -> bool:
@@ -326,6 +391,7 @@ def main(argv=None) -> int:
         check_transport(),
         check_robust_aggregation(),
         check_compile_cache(),
+        check_serving(),
     ]
     bad = checks.count(False)
     print(f"{len(checks) - bad}/{len(checks)} checks passed")

@@ -150,6 +150,23 @@ def find_resumable(path: str, max_rotations: int = 8) -> str | None:
     return gens[0] if gens else None
 
 
+def checkpoint_fingerprint(path: str) -> str:
+    """Content hash of a checkpoint directory (12 hex chars).
+
+    Streams ``host.pkl`` + ``arrays.npz`` through sha256, so the id is a
+    pure function of the artifact bytes: the serving registry uses it as
+    the model id, and hot-reload fires exactly when a new generation's
+    bytes differ (a rewrite of identical content keeps the same id)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for fname in (_HOST, _ARRAYS):
+        with open(os.path.join(path, fname), "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()[:12]
+
+
 def _fault_hook(path: str) -> None:
     """Mid-write fault-injection point (no-op unless a plan is active)."""
     try:

@@ -1,0 +1,25 @@
+"""Synthetic-data serving: registry + compiled sampling engine + HTTP service.
+
+The reference hands consumers per-epoch CSV snapshots; the CLI's
+``--sample-from`` regenerates one batch and exits.  This package is the
+long-lived, request-driven path the ROADMAP's "serves heavy traffic" north
+star needs:
+
+- ``registry``   — resolves run artifacts (the ``--sample-from`` discovery
+  logic, factored out of the CLI), content-hashes checkpoints into model
+  ids, and hot-reloads when a newer generation lands;
+- ``engine``     — one jitted program per (batch-bucket, conditional)
+  fusing generator forward + conditional draw + device decode, with a
+  deterministic offset-addressable row stream (N rows in K chunks is
+  bit-identical to one N-row draw);
+- ``service``    — stdlib-only HTTP server with a bounded queue,
+  micro-batch coalescing, load shedding, and graceful drain;
+- ``metrics``    — request latency / queue depth / batch occupancy /
+  rows-per-second counters behind ``/healthz`` and ``/metrics``;
+- ``demo``       — a tiny self-contained artifact builder the doctor
+  check, serving bench, and tests share.
+"""
+
+from __future__ import annotations
+
+__all__ = ["demo", "engine", "metrics", "registry", "service"]

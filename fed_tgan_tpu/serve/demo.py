@@ -1,0 +1,73 @@
+"""Self-contained demo artifact builder for the serving layer.
+
+Trains a deliberately tiny standalone synthesizer on a synthesized
+mixed-type table and persists the full ``--save-model`` artifact layout
+(``models/synthesizer`` + meta JSON + encoder pickle) — the doctor's
+serving check, ``bench.py --workload serving``, and the hermetic service
+tests all need a real loadable artifact without shipping data files or
+paying a real training run.  Seconds on CPU: one epoch, batch 50,
+embedding 16.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+
+def demo_frame(rows: int = 200, seed: int = 0):
+    """Mixed-type table: continuous, non-negative, two categoricals."""
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "amount": np.exp(rng.normal(2.0, 1.0, rows)).round(2),
+        "score": np.concatenate([
+            rng.normal(-4.0, 0.5, rows // 2),
+            rng.normal(3.0, 1.0, rows - rows // 2),
+        ]),
+        "color": rng.choice(["red", "green", "blue"], rows, p=[0.6, 0.3, 0.1]),
+        "flag": rng.choice(["yes", "no"], rows, p=[0.8, 0.2]),
+    })
+
+
+def build_demo_artifact(out_dir: str, rows: int = 200, seed: int = 0,
+                        epochs: int = 1, batch_size: int = 50,
+                        embedding_dim: int = 16, name: str = "demo") -> str:
+    """Train + persist the demo artifact under ``out_dir``; returns
+    ``out_dir`` (resolvable by ``registry.resolve_artifact``).
+
+    Mirrors the CLI standalone ``--save-model`` block: meta/encoders
+    first, the synthesizer last, so the registry's meta-freshness check
+    sees the healthy ordering."""
+    from fed_tgan_tpu.data.encoders import encoder_artifact
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.federation.init import harmonize_categories
+    from fed_tgan_tpu.runtime.checkpoint import save_synthesizer
+    from fed_tgan_tpu.train.standalone import StandaloneSynthesizer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    pre = TablePreprocessor(
+        frame=demo_frame(rows, seed), name=name,
+        categorical_columns=["color", "flag"],
+        non_negative_columns=["amount"],
+    )
+    meta, encoders, _ = harmonize_categories([pre.local_meta()])
+    matrix, cat_idx, ord_idx = pre.encode(encoders)
+
+    cfg = TrainConfig(batch_size=batch_size, embedding_dim=embedding_dim,
+                      gen_dims=(32, 32), dis_dims=(32, 32))
+    synth = StandaloneSynthesizer(config=cfg, seed=seed)
+    synth.fit(matrix, cat_idx, ord_idx, epochs=epochs)
+
+    models_dir = os.path.join(out_dir, "models")
+    os.makedirs(models_dir, exist_ok=True)
+    table_meta = pre.global_table_meta(meta)
+    table_meta.dump_json(os.path.join(models_dir, f"{name}.json"))
+    with open(os.path.join(models_dir, f"label_encoders_{name}.pickle"),
+              "wb") as f:
+        pickle.dump(
+            encoder_artifact(table_meta.categorical_columns, encoders), f)
+    save_synthesizer(synth, os.path.join(models_dir, "synthesizer"))
+    return out_dir

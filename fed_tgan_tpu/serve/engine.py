@@ -1,0 +1,257 @@
+"""Compiled batched sampling engine behind the serving layer.
+
+One jitted program per (batch-bucket, conditional?) fuses the generator
+forward pass, the conditional-vector draw, gumbel activation, and the
+device-side inverse transform (``ops.decode.make_device_decode``) — a
+request costs one device dispatch plus one (n, n_columns) host transfer.
+
+Determinism contract: rows form a virtual stream addressed by
+``(seed, row_offset)``.  Step ``s`` of stream ``seed`` is generated with
+``fold_in(key(seed + key_offset), s)`` — a pure function of the absolute
+step index, never of the request that happened to cover it — so N rows
+fetched in K chunks are bit-identical to one N-row draw, and bucket
+padding (requests are rounded up to power-of-two step counts so the
+compiled-program set stays tiny) can never perturb earlier rows.
+
+Conditional sampling (CTGAN's generation-time knob: fix one discrete
+column to a chosen option) swaps the empirical conditional draw for a
+constant one-hot; the condition position is a traced scalar, so every
+(column, value) pair shares one compiled program per bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from fed_tgan_tpu.serve.registry import LoadedModel
+
+
+class ConditionError(ValueError):
+    """Unknown column / value for a conditional sampling request."""
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class SamplingEngine:
+    """Offset-addressable deterministic sampling over one loaded model."""
+
+    def __init__(self, model: LoadedModel, max_chunk_steps: int = 128):
+        self.max_chunk_steps = max_chunk_steps
+        self._programs: dict = {}
+        self._adopt_fields(model)
+
+    def _adopt_fields(self, model: LoadedModel) -> None:
+        from fed_tgan_tpu.ops.decode import make_device_decode
+
+        self.model = model
+        synth = model.synth
+        self.spec, self.cfg = synth.spec, synth.cfg
+        self._decode_fn = make_device_decode(synth.transformer.columns)
+
+    def adopt(self, model: LoadedModel) -> bool:
+        """Swap in a hot-reloaded model.  When the encoded layout and
+        sampling config are unchanged (the common keep-training case) the
+        compiled programs are kept — new params are just new arguments —
+        and adoption is free; otherwise the program cache is rebuilt.
+        Returns whether the programs were kept."""
+        same_shape = (
+            model.synth.transformer.output_info
+            == self.model.synth.transformer.output_info
+            and model.synth.cfg == self.cfg
+            and self._decode_plan_signature(model)
+            == self._decode_plan_signature(self.model)
+        )
+        if not same_shape:
+            self._programs = {}
+        self._adopt_fields(model)
+        return same_shape
+
+    @staticmethod
+    def _decode_plan_signature(model: LoadedModel) -> tuple:
+        """The decode constants a compiled program bakes in: GMM mode
+        means/stds per continuous column, code tables per discrete one."""
+        from fed_tgan_tpu.features.transformer import ContinuousColumn
+
+        sig = []
+        for col in model.synth.transformer.columns:
+            if isinstance(col, ContinuousColumn):
+                active = np.flatnonzero(col.gmm.active)
+                sig.append(("cont", col.gmm.means[active].tobytes(),
+                            col.gmm.stds[active].tobytes()))
+            else:
+                sig.append(("disc", np.asarray(col.codes).tobytes()))
+        return tuple(sig)
+
+    # ------------------------------------------------------------ programs
+
+    def _program(self, n_steps: int, conditional: bool):
+        key = (n_steps, conditional)
+        if key not in self._programs:
+            import jax
+            import jax.numpy as jnp
+
+            from fed_tgan_tpu.models.ctgan import generator_apply
+            from fed_tgan_tpu.ops.segments import apply_activate
+
+            spec, cfg, decode_fn = self.spec, self.cfg, self._decode_fn
+            B, emb = cfg.batch_size, cfg.embedding_dim
+
+            def run(params_g, state_g, cond, key, start, pos):
+                # one step == make_sample_step's draw exactly (kz/kc/ka
+                # split order), so the unconditional stream is bit-identical
+                # to SavedSynthesizer.sample_encoded's schedule
+                def single(k):
+                    kz, kc, ka = jax.random.split(k, 3)
+                    z = jax.random.normal(kz, (B, emb))
+                    if spec.n_discrete > 0:
+                        if conditional:
+                            c = jnp.broadcast_to(
+                                (jnp.arange(spec.n_opt) == pos)
+                                .astype(z.dtype)[None, :],
+                                (B, spec.n_opt),
+                            )
+                        else:
+                            c = cond.sample_empirical(kc, B)
+                        z = jnp.concatenate([z, c], axis=1)
+                    raw, _ = generator_apply(params_g, state_g, z, train=False)
+                    return apply_activate(raw, spec, ka)
+
+                def body(carry, i):
+                    return carry, single(jax.random.fold_in(key, start + i))
+
+                _, out = jax.lax.scan(body, None, jnp.arange(n_steps))
+                return decode_fn(out.reshape(n_steps * B, -1))
+
+            self._programs[key] = jax.jit(run)
+        return self._programs[key]
+
+    def _chunk_plan(self, first_step: int, total_steps: int):
+        """(start_step, n_steps) chunks covering ``total_steps`` from
+        ``first_step``: full ``max_chunk_steps`` blocks, then a power-of-two
+        bucketed tail — compiled step counts are only 1, 2, 4, ...,
+        max_chunk_steps regardless of request sizes."""
+        plan, start = [], first_step
+        end = first_step + total_steps
+        while start < end:
+            remaining = end - start
+            steps = (self.max_chunk_steps if remaining >= self.max_chunk_steps
+                     else min(_pow2(remaining), self.max_chunk_steps))
+            plan.append((start, steps))
+            start += steps
+        return plan
+
+    # ------------------------------------------------------------ sampling
+
+    def resolve_condition(self, column: str, value) -> int:
+        """(column name, raw category value) -> conditional-vector position."""
+        from fed_tgan_tpu.features.transformer import DiscreteColumn
+
+        meta = self.model.meta
+        columns = self.model.synth.transformer.columns
+        # the i-th transformer column IS the i-th meta column — the exact
+        # correspondence decode_matrix decodes by (transformer names are
+        # positional in the standalone path, so resolve via the meta)
+        names = list(meta.column_names)
+        if len(names) != len(columns):
+            raise ConditionError(
+                "conditional sampling unsupported for this table: encoded "
+                f"layout has {len(columns)} columns but the meta {len(names)} "
+                "(date part-columns?)"
+            )
+        if column not in names:
+            raise ConditionError(
+                f"unknown column {column!r} (have {names})"
+            )
+        idx = names.index(column)
+        tcol = columns[idx]
+        if not isinstance(tcol, DiscreteColumn):
+            raise ConditionError(
+                f"column {column!r} is continuous; conditional sampling "
+                "fixes a DISCRETE column to one of its options"
+            )
+        cats = list(meta.categorical_columns)
+        if column not in cats:
+            raise ConditionError(f"column {column!r} has no encoder")
+        enc = self.model.encoders[cats.index(column)]
+        try:
+            code = int(enc.transform([value])[0])
+        except ValueError:
+            try:  # HTTP query params arrive as strings; retry coerced
+                code = int(enc.transform([str(value)])[0])
+            except ValueError as exc:
+                raise ConditionError(str(exc)) from None
+        slots = np.flatnonzero(np.asarray(tcol.codes) == code)
+        if not len(slots):
+            raise ConditionError(
+                f"value {value!r} of column {column!r} never occurred in "
+                "training data (no generator slot)"
+            )
+        # every softmax segment is one transformer column, in column order,
+        # so the column index IS the conditional-column index
+        return int(self.spec.cond_offsets[idx]) + int(slots[0])
+
+    def sample_decoded(self, n: int, seed: int = 0, offset: int = 0,
+                       condition: Optional[int] = None) -> np.ndarray:
+        """Rows [offset, offset + n) of stream ``seed`` as the decoded
+        numeric (n, n_columns) matrix (device decode, float32).
+
+        ``condition``: a position from :meth:`resolve_condition`, or None
+        for the empirical conditional draw (the reference's sampling)."""
+        import jax
+
+        if n <= 0:
+            raise ValueError(f"n={n}: need at least one row")
+        if offset < 0:
+            raise ValueError(f"offset={offset}: must be >= 0")
+        B = self.cfg.batch_size
+        synth = self.model.synth
+        first_step, skip = divmod(offset, B)
+        total_steps = -(-(skip + n) // B)
+        key = jax.random.key(seed + synth.key_offset)
+        conditional = condition is not None
+        pos = np.int32(condition if conditional else 0)
+
+        out, pending = [], []
+        for start, steps in self._chunk_plan(first_step, total_steps):
+            # double-buffered like SampleProgramCache.sample: chunk i+1
+            # computes while chunk i transfers, at most 2 buffers live
+            chunk = self._program(steps, conditional)(
+                synth.params_g, synth.state_g, synth.cond, key, start, pos
+            )
+            chunk.copy_to_host_async()
+            pending.append(chunk)
+            if len(pending) == 2:
+                out.append(np.asarray(pending.pop(0)))
+        out.extend(np.asarray(p) for p in pending)
+        return np.concatenate(out, axis=0)[skip:skip + n]
+
+    def sample_frame(self, n: int, seed: int = 0, offset: int = 0,
+                     condition: Optional[int] = None):
+        """Decoded raw-format DataFrame (categories as strings, dates
+        rejoined) — exactly what the one-shot CSV path writes."""
+        from fed_tgan_tpu.data.decode import decode_matrix
+
+        mat = self.sample_decoded(n, seed=seed, offset=offset,
+                                  condition=condition)
+        return decode_matrix(mat, self.model.meta, self.model.encoders)
+
+    def sample_csv_bytes(self, n: int, seed: int = 0, offset: int = 0,
+                         condition: Optional[int] = None,
+                         header: bool = True) -> bytes:
+        """CSV bytes with the same formatting as ``data.csvio.write_csv``
+        (the one-shot file), so served output is byte-comparable to it."""
+        from fed_tgan_tpu.data.csvio import csv_bytes
+
+        frame = self.sample_frame(n, seed=seed, offset=offset,
+                                  condition=condition)
+        out = csv_bytes(frame)
+        if not header:
+            out = out.split(b"\n", 1)[1]
+        return out

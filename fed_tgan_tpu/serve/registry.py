@@ -1,0 +1,249 @@
+"""Model registry over run artifacts and keep-K checkpoints.
+
+One trained run leaves three artifacts under ``<out>/models/`` (the layout
+both ``--save-model`` paths and the multihost server write):
+
+- ``synthesizer/``                      the sampling checkpoint
+  (``runtime.checkpoint.save_synthesizer``: host.pkl + arrays.npz);
+- ``<name>.json``                       the global ``TableMeta``;
+- ``label_encoders_<name>.pickle``      the harmonized category encoders.
+
+:func:`resolve_artifact` is the ``--sample-from`` discovery logic factored
+out of the CLI (same candidate walk, same pairing rules, same messages) so
+the one-shot path and the serving registry cannot drift.  A loaded model's
+identity is the content hash of its checkpoint bytes
+(:func:`runtime.checkpoint.checkpoint_fingerprint`), which makes hot-reload
+exact: :meth:`ModelRegistry.maybe_reload` swaps models precisely when a new
+checkpoint generation with different bytes has been published (atomic
+rename, so a half-written save is never picked up).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Sequence
+
+
+class ArtifactError(RuntimeError):
+    """No loadable run artifact at the requested root."""
+
+
+class MetaMismatchError(ArtifactError):
+    """The newest meta JSON postdates the saved synthesizer."""
+
+
+@dataclass(frozen=True)
+class ResolvedArtifact:
+    """Paths of one run's sampling artifacts (nothing loaded yet)."""
+
+    models_dir: str
+    synth_dir: str
+    meta_path: str
+    enc_path: str
+    name: str
+
+
+def resolve_artifact(root: str, log=print) -> ResolvedArtifact:
+    """Locate the synthesizer + meta/encoder pair under ``root``.
+
+    ``root`` may be the run's out-dir, its ``models`` dir, or the
+    synthesizer dir itself — the same three candidates the CLI's
+    ``--sample-from`` accepted.  Raises :class:`ArtifactError` with the
+    train-first hint when nothing loadable exists."""
+    root = os.path.abspath(root)
+    candidates = [os.path.join(root, "models"), root, os.path.dirname(root)]
+    for cand in candidates:
+        synth = os.path.join(cand, "synthesizer")
+        # a meta JSON counts only with its paired encoder pickle (the two
+        # decode artifacts are written together)
+        metas = [
+            m for m in sorted(glob.glob(os.path.join(cand, "*.json")))
+            if os.path.exists(os.path.join(
+                cand,
+                "label_encoders_"
+                f"{os.path.splitext(os.path.basename(m))[0]}.pickle",
+            ))
+        ]
+        if os.path.isdir(synth) and metas:
+            if len(metas) > 1:
+                # several runs share this models dir; the synthesizer dir
+                # holds only the LAST-saved artifact, so take the newest
+                # meta (written in the same run) and say so
+                metas.sort(key=os.path.getmtime)
+                log(
+                    "--sample-from: multiple run artifacts in "
+                    f"{cand} ({[os.path.basename(m) for m in metas]}); "
+                    f"using the newest: {os.path.basename(metas[-1])}"
+                )
+            name = os.path.splitext(os.path.basename(metas[-1]))[0]
+            return ResolvedArtifact(
+                models_dir=cand,
+                synth_dir=synth,
+                meta_path=metas[-1],
+                enc_path=os.path.join(cand, f"label_encoders_{name}.pickle"),
+                name=name,
+            )
+    raise ArtifactError(
+        f"no synthesizer artifact + meta JSON/encoder pair found under any "
+        f"of {candidates} (train once with --save-model first)"
+    )
+
+
+def check_meta_freshness(art: ResolvedArtifact, allow: bool = False,
+                         log=print) -> None:
+    """Reject a meta JSON newer than the saved synthesizer.
+
+    meta/encoders are written at training START, the synthesizer at the
+    END — a later run that crashed (or omitted --save-model) leaves the
+    newest meta paired with an OLDER run's synthesizer.  Decoding through
+    mismatched artifacts produces wrong categories or a shape error, so
+    this is a hard :class:`MetaMismatchError` unless ``allow`` (the
+    ``--allow-meta-mismatch`` escape hatch) downgrades it to a warning."""
+    try:
+        synth_mtime = max(
+            os.path.getmtime(os.path.join(art.synth_dir, f))
+            for f in os.listdir(art.synth_dir)
+        )
+        stale = os.path.getmtime(art.meta_path) > synth_mtime
+    except (OSError, ValueError):
+        return  # unreadable/empty synth dir: load_synthesizer will explain
+    if not stale:
+        return
+    msg = (
+        f"meta {os.path.basename(art.meta_path)} is newer than the saved "
+        "synthesizer — the run that wrote it likely never saved a model "
+        "(crashed or ran without --save-model).  If the schema changed "
+        "between runs, sampling through the OLDER synthesizer decodes "
+        "wrong categories or fails on shapes"
+    )
+    if not allow:
+        raise MetaMismatchError(
+            f"{msg}; pass --allow-meta-mismatch to sample anyway"
+        )
+    log(f"WARNING: {msg} (proceeding: --allow-meta-mismatch)")
+
+
+@dataclass
+class LoadedModel:
+    """One fully-loaded serving model: synthesizer + decode artifacts."""
+
+    model_id: str          # checkpoint content hash (12 hex chars)
+    synth: object          # runtime.checkpoint.SavedSynthesizer
+    meta: object           # data.schema.TableMeta
+    encoders: Sequence     # data.encoders.CategoryEncoder per categorical
+    artifact: ResolvedArtifact
+    loaded_at: float = field(default_factory=time.time)
+
+
+def load_model(art: ResolvedArtifact, source_dir: str | None = None) -> LoadedModel:
+    """Load the synthesizer + decode artifacts into a :class:`LoadedModel`.
+
+    ``source_dir`` overrides the checkpoint directory (a rotation slot like
+    ``synthesizer.1``) while meta/encoders still come from ``art``."""
+    from fed_tgan_tpu.data.schema import TableMeta
+    from fed_tgan_tpu.runtime.checkpoint import (
+        checkpoint_fingerprint,
+        load_synthesizer,
+    )
+
+    synth_dir = source_dir or art.synth_dir
+    model_id = checkpoint_fingerprint(synth_dir)
+    synth = load_synthesizer(synth_dir)
+    meta = TableMeta.load_json(art.meta_path)
+    with open(art.enc_path, "rb") as f:
+        encoders = [d["label_encoder"] for d in pickle.load(f)]
+    return LoadedModel(
+        model_id=model_id, synth=synth, meta=meta, encoders=encoders,
+        artifact=art,
+    )
+
+
+class ModelRegistry:
+    """Lazily-loaded, hot-reloadable model over one artifact root.
+
+    ``get()`` loads on first use; ``maybe_reload()`` is the cheap poll the
+    service worker calls between micro-batches: a stat-signature check
+    first (mtimes + sizes of the checkpoint payload and meta), then the
+    content fingerprint only when the stats moved, then a full reload only
+    when the bytes actually changed AND the new generation is loadable
+    (half-published checkpoints and torn writes are skipped — the previous
+    model keeps serving)."""
+
+    def __init__(self, root: str, allow_meta_mismatch: bool = False,
+                 log=print):
+        self.root = root
+        self.allow_meta_mismatch = allow_meta_mismatch
+        self._log = log
+        self._model: LoadedModel | None = None
+        self._stat_sig: tuple | None = None
+
+    def _resolve_checked(self) -> ResolvedArtifact:
+        art = resolve_artifact(self.root, log=self._log)
+        check_meta_freshness(art, allow=self.allow_meta_mismatch,
+                             log=self._log)
+        return art
+
+    @staticmethod
+    def _stat_signature(art: ResolvedArtifact) -> tuple:
+        parts = []
+        for p in (os.path.join(art.synth_dir, "host.pkl"),
+                  os.path.join(art.synth_dir, "arrays.npz"),
+                  art.meta_path):
+            try:
+                st = os.stat(p)
+                parts.append((p, st.st_mtime_ns, st.st_size))
+            except OSError:
+                parts.append((p, None, None))
+        return tuple(parts)
+
+    def get(self) -> LoadedModel:
+        if self._model is None:
+            art = self._resolve_checked()
+            self._model = load_model(art)
+            self._stat_sig = self._stat_signature(art)
+        return self._model
+
+    def maybe_reload(self) -> bool:
+        """Swap in a newer checkpoint generation if one landed; returns
+        whether a reload happened.  Never raises: a torn or mismatched new
+        artifact is logged and the current model keeps serving."""
+        if self._model is None:
+            return False
+        try:
+            art = resolve_artifact(self.root, log=lambda *_: None)
+        except ArtifactError:
+            return False
+        sig = self._stat_signature(art)
+        if sig == self._stat_sig:
+            return False
+        from fed_tgan_tpu.runtime.checkpoint import (
+            _is_valid_checkpoint,
+            checkpoint_fingerprint,
+        )
+
+        if not _is_valid_checkpoint(art.synth_dir):
+            return False  # mid-publish: catch it on the next poll
+        try:
+            if checkpoint_fingerprint(art.synth_dir) == self._model.model_id:
+                self._stat_sig = sig  # rewrite of identical bytes
+                return False
+            check_meta_freshness(art, allow=self.allow_meta_mismatch,
+                                 log=self._log)
+            model = load_model(art)
+        except ArtifactError as exc:
+            self._log(f"registry: reload skipped ({exc})")
+            self._stat_sig = sig  # don't re-log every poll
+            return False
+        except Exception as exc:  # torn write raced past the validity probe
+            self._log(f"registry: reload failed ({exc!r}); keeping "
+                      f"{self._model.model_id}")
+            return False
+        self._log(f"registry: hot-reload {self._model.model_id} -> "
+                  f"{model.model_id}")
+        self._model = model
+        self._stat_sig = sig
+        return True

@@ -1,0 +1,456 @@
+"""Long-lived stdlib-only sampling server + the paired CLI client.
+
+Request path: HTTP handler threads validate and enqueue; one batch worker
+drains up to ``max_batch`` queued requests per cycle (micro-batch
+coalescing — under concurrent clients the queue builds while a batch
+computes, so the next cycle serves several requests back-to-back without
+re-entering the Python dispatch overhead per request), runs them through
+the compiled engine, and flips each request's event.  The queue is
+bounded: a full queue sheds load with 503 + Retry-After instead of
+building an unbounded latency tail.  Shutdown drains: new requests are
+rejected, everything already queued is answered, then the worker exits.
+
+Endpoints:
+
+- ``GET/POST /sample``  rows/seed/offset/column/value/header params;
+  returns ``text/csv`` bytes identical to the one-shot ``--sample-from``
+  file for the same (rows, seed) — see the engine's determinism contract.
+- ``GET /healthz``      JSON liveness + model id + counters.
+- ``GET /metrics``      Prometheus text exposition.
+
+Everything here is stdlib (http.server, queue, threading); jax only runs
+inside the engine the worker calls.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from fed_tgan_tpu.serve.engine import ConditionError, SamplingEngine
+from fed_tgan_tpu.serve.metrics import ServiceMetrics
+from fed_tgan_tpu.serve.registry import ModelRegistry
+
+_STOP = object()
+
+
+@dataclass
+class _Request:
+    n: int
+    seed: int
+    offset: int
+    condition: int | None
+    header: bool
+    enqueued_at: float = field(default_factory=time.time)
+    done: threading.Event = field(default_factory=threading.Event)
+    result: bytes | None = None
+    error: str | None = None
+    status: int = 500
+
+
+class SamplingService:
+    """One registry-backed engine behind a bounded-queue HTTP server."""
+
+    def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
+                 port: int = 0, max_batch: int = 8, queue_size: int = 64,
+                 request_timeout_s: float = 120.0,
+                 reload_interval_s: float = 5.0, log=print):
+        self.registry = registry
+        self.engine = SamplingEngine(registry.get())
+        self.metrics = ServiceMetrics()
+        self.max_batch = max(1, int(max_batch))
+        self.request_timeout_s = request_timeout_s
+        self.reload_interval_s = reload_interval_s
+        self._log = log
+        self._host, self._port = host, port
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(queue_size)))
+        self._draining = threading.Event()
+        self._last_reload_check = time.monotonic()
+        self._httpd: ThreadingHTTPServer | None = None
+        self._worker_thread: threading.Thread | None = None
+        self._serve_thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "SamplingService":
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
+        self._httpd.daemon_threads = True
+        self._worker_thread = threading.Thread(
+            target=self._worker, name="serve-batch-worker", daemon=True)
+        self._worker_thread.start()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            name="serve-http", daemon=True)
+        self._serve_thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "start() first"
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop accepting, answer (or fail) everything queued, stop."""
+        self._draining.set()
+        if not drain:
+            # fail queued requests instead of computing them
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is not _STOP:
+                    req.error, req.status = "server shutting down", 503
+                    req.done.set()
+        try:
+            self._queue.put_nowait(_STOP)
+        except queue.Full:
+            pass  # worker is alive and draining; it exits on _draining
+        if self._worker_thread is not None:
+            self._worker_thread.join(timeout=max(self.request_timeout_s, 10))
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10)
+
+    # -------------------------------------------------------- request path
+
+    def submit(self, req: _Request) -> bool:
+        """Enqueue; False = shed (queue full or draining)."""
+        if self._draining.is_set():
+            return False
+        try:
+            self._queue.put_nowait(req)
+            return True
+        except queue.Full:
+            self.metrics.record_shed()
+            return False
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    # ------------------------------------------------------------- worker
+
+    def _worker(self) -> None:
+        while True:
+            try:
+                item = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._draining.is_set():
+                    return
+                self._maybe_reload()
+                continue
+            if item is _STOP:
+                self._process(self._drain_remaining())
+                return
+            batch = [item]
+            stop = False
+            while len(batch) < self.max_batch:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                batch.append(nxt)
+            self._process(batch)
+            if stop:
+                self._process(self._drain_remaining())
+                return
+            self._maybe_reload()
+
+    def _drain_remaining(self) -> list:
+        batch = []
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return batch
+            if req is not _STOP:
+                batch.append(req)
+
+    def _process(self, batch: list) -> None:
+        if not batch:
+            return
+        self.metrics.record_batch(len(batch))
+        for req in batch:
+            try:
+                req.result = self.engine.sample_csv_bytes(
+                    req.n, seed=req.seed, offset=req.offset,
+                    condition=req.condition, header=req.header,
+                )
+                req.status = 200
+                self.metrics.record_request(
+                    time.time() - req.enqueued_at, req.n)
+            except Exception as exc:  # noqa: BLE001 — becomes the 500 body
+                req.error, req.status = repr(exc), 500
+                self.metrics.record_error()
+            finally:
+                req.done.set()
+
+    def _maybe_reload(self) -> None:
+        if self.reload_interval_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_reload_check < self.reload_interval_s:
+            return
+        self._last_reload_check = now
+        try:
+            if self.registry.maybe_reload():
+                kept = self.engine.adopt(self.registry.get())
+                self.metrics.record_reload()
+                self._log(
+                    f"service: now serving model "
+                    f"{self.registry.get().model_id} "
+                    f"({'programs kept' if kept else 'programs rebuilt'})"
+                )
+        except Exception as exc:  # noqa: BLE001 — reload must never kill serving
+            self._log(f"service: reload check failed ({exc!r})")
+
+
+def _make_handler(service: SamplingService):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _send(self, status: int, body: bytes, ctype: str,
+                  extra: dict | None = None) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, status: int, obj: dict,
+                       extra: dict | None = None) -> None:
+            self._send(status, json.dumps(obj).encode(), "application/json",
+                       extra)
+
+        def do_GET(self):
+            parsed = urllib.parse.urlsplit(self.path)
+            if parsed.path == "/healthz":
+                snap = service.metrics.snapshot(service.queue_depth())
+                model = service.registry.get()
+                self._send_json(200, {
+                    "status": "draining" if service._draining.is_set()
+                    else "ok",
+                    "model_id": model.model_id,
+                    "model_name": model.artifact.name,
+                    **snap,
+                })
+            elif parsed.path == "/metrics":
+                text = service.metrics.render_prometheus(
+                    service.queue_depth())
+                self._send(200, text.encode(), "text/plain; version=0.0.4")
+            elif parsed.path == "/sample":
+                params = {k: v[-1] for k, v in
+                          urllib.parse.parse_qs(parsed.query).items()}
+                self._handle_sample(params)
+            else:
+                self._send_json(404, {"error": f"no route {parsed.path}"})
+
+        def do_POST(self):
+            parsed = urllib.parse.urlsplit(self.path)
+            if parsed.path != "/sample":
+                self._send_json(404, {"error": f"no route {parsed.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                params = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(params, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, json.JSONDecodeError) as exc:
+                self._send_json(400, {"error": f"bad JSON body: {exc}"})
+                return
+            self._handle_sample(params)
+
+        def _handle_sample(self, params: dict) -> None:
+            try:
+                n = int(params.get("rows", params.get("n", 0)))
+                seed = int(params.get("seed", 0))
+                offset = int(params.get("offset", 0))
+                header = str(params.get("header", "1")) not in ("0", "false")
+                if n <= 0:
+                    raise ValueError(f"rows={n}: need a positive row count")
+                if offset < 0:
+                    raise ValueError(f"offset={offset}: must be >= 0")
+            except (TypeError, ValueError) as exc:
+                self._send_json(400, {"error": str(exc)})
+                return
+            condition = None
+            column = params.get("column")
+            if column:
+                try:
+                    condition = service.engine.resolve_condition(
+                        column, params.get("value"))
+                except ConditionError as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+            req = _Request(n=n, seed=seed, offset=offset,
+                           condition=condition, header=header)
+            if not service.submit(req):
+                self._send_json(
+                    503,
+                    {"error": "draining" if service._draining.is_set()
+                     else "queue full"},
+                    extra={"Retry-After": "1"},
+                )
+                return
+            if not req.done.wait(timeout=service.request_timeout_s):
+                self._send_json(504, {"error": "request timed out in queue"})
+                return
+            if req.status == 200 and req.result is not None:
+                self._send(200, req.result, "text/csv")
+            else:
+                self._send_json(req.status, {"error": req.error or "failed"})
+
+    return Handler
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def serve_main(argv=None) -> int:
+    """``fed-tgan-tpu serve <artifact-dir> [flags]``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="fed_tgan_tpu serve",
+        description="serve synthetic rows from a --save-model artifact "
+                    "over HTTP (long-lived, compile-once)")
+    ap.add_argument("artifact", help="run out-dir / models dir / "
+                    "synthesizer dir (same resolution as --sample-from)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7799,
+                    help="0 = ephemeral (printed at startup)")
+    ap.add_argument("--max-batch", type=int, default=8,
+                    help="max requests coalesced per worker cycle")
+    ap.add_argument("--queue-size", type=int, default=64,
+                    help="bounded request queue; full = shed with 503")
+    ap.add_argument("--request-timeout", type=float, default=120.0,
+                    help="seconds a request may wait before 504")
+    ap.add_argument("--reload-interval", type=float, default=5.0,
+                    help="seconds between hot-reload polls (0 = never)")
+    ap.add_argument("--allow-meta-mismatch", action="store_true",
+                    help="serve even when the meta JSON postdates the "
+                         "synthesizer (see --sample-from)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    from fed_tgan_tpu.cli import _enable_compile_cache
+    from fed_tgan_tpu.serve.registry import ArtifactError
+
+    # warm restarts skip the per-bucket XLA compiles entirely
+    _enable_compile_cache()
+    log = (lambda *a, **k: None) if args.quiet else print
+    try:
+        registry = ModelRegistry(args.artifact,
+                                 allow_meta_mismatch=args.allow_meta_mismatch,
+                                 log=log)
+        service = SamplingService(
+            registry, host=args.host, port=args.port,
+            max_batch=args.max_batch, queue_size=args.queue_size,
+            request_timeout_s=args.request_timeout,
+            reload_interval_s=args.reload_interval, log=log,
+        )
+    except ArtifactError as exc:
+        print(f"serve: {exc}")
+        return 2
+    service.start()
+    model = registry.get()
+    print(f"serving model {model.model_id} ({model.artifact.name}) "
+          f"on {service.url}  (endpoints: /sample /healthz /metrics; "
+          "Ctrl-C drains and exits)", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("serve: draining...", flush=True)
+        service.shutdown(drain=True)
+    return 0
+
+
+def client_main(argv=None) -> int:
+    """``fed-tgan-tpu sample-client --url ... --rows N [--chunks K]``.
+
+    Chunked fetches are offset-contiguous, so the concatenated output is
+    bit-identical to one N-row request (the engine's determinism
+    contract) — K is purely a transfer-sizing knob."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="fed_tgan_tpu sample-client",
+        description="fetch synthetic rows from a running serve instance")
+    ap.add_argument("--url", default="http://127.0.0.1:7799",
+                    help="server base URL")
+    ap.add_argument("--rows", type=int, required=True)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--offset", type=int, default=0,
+                    help="starting row of the deterministic stream")
+    ap.add_argument("--chunks", type=int, default=1,
+                    help="split the fetch into K contiguous requests")
+    ap.add_argument("--column", default=None,
+                    help="conditional sampling: discrete column to fix")
+    ap.add_argument("--value", default=None,
+                    help="conditional sampling: the option to fix it to")
+    ap.add_argument("--out", default=None,
+                    help="output CSV path (default: stdout)")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    args = ap.parse_args(argv)
+    if args.rows <= 0:
+        ap.error(f"--rows {args.rows}: must be positive")
+    if not 1 <= args.chunks <= args.rows:
+        ap.error(f"--chunks {args.chunks}: must be in [1, rows]")
+    if (args.column is None) != (args.value is None):
+        ap.error("--column and --value go together")
+
+    base, done = args.rows // args.chunks, 0
+    parts = []
+    for i in range(args.chunks):
+        n = base + (1 if i < args.rows % args.chunks else 0)
+        if n == 0:
+            continue
+        q = {"rows": n, "seed": args.seed, "offset": args.offset + done,
+             "header": int(i == 0)}
+        if args.column is not None:
+            q.update(column=args.column, value=args.value)
+        url = f"{args.url}/sample?{urllib.parse.urlencode(q)}"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                parts.append(resp.read())
+        except urllib.error.HTTPError as exc:
+            print(f"sample-client: HTTP {exc.code}: "
+                  f"{exc.read().decode(errors='replace')}", file=sys.stderr)
+            return 1
+        except OSError as exc:
+            print(f"sample-client: {exc} (is `fed-tgan-tpu serve` running "
+                  f"at {args.url}?)", file=sys.stderr)
+            return 1
+        done += n
+    blob = b"".join(parts)
+    if args.out:
+        with open(args.out, "wb") as f:
+            f.write(blob)
+        print(f"wrote {args.rows} rows to {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.buffer.write(blob)
+    return 0

@@ -67,9 +67,16 @@ class CondSampler:
         for c in range(spec.n_discrete):
             size = int(spec.cond_sizes[c])
             freq = counts[c, :size]
+            if freq.sum() <= 0:
+                # all-zero counts (empty/fully-quarantined shard): log(1)=0
+                # everywhere would make logf/logf.sum() = 0/0 = NaN and
+                # poison every conditional draw — fall back to uniform
+                p_train[c, :size] = 1.0 / size
+                p_emp[c, :size] = 1.0 / size
+                continue
             logf = np.log(freq + 1.0)
             p_train[c, :size] = logf / logf.sum()
-            p_emp[c, :size] = freq / max(freq.sum(), 1.0)
+            p_emp[c, :size] = freq / freq.sum()
         return cls(p_train=jnp.asarray(p_train), p_empirical=jnp.asarray(p_emp), spec=spec)
 
     @classmethod

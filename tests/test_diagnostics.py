@@ -75,10 +75,10 @@ def test_doctor_cli_all_green_on_cpu(tmp_path):
         cwd="/root/repo",
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
-    assert "6/6 checks passed" in proc.stdout
+    assert "7/7 checks passed" in proc.stdout
     assert "FAIL" not in proc.stdout
     for name in ("runtime", "backend", "virtual-mesh", "transport",
-                 "robust-agg", "compile-cache"):
+                 "robust-agg", "compile-cache", "serving"):
         assert f"OK   {name}" in proc.stdout, proc.stdout
 
 

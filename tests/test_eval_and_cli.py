@@ -433,19 +433,19 @@ def test_monitor_log_rows_survive_without_close(tmp_path):
     assert not (tmp_path / "never.csv").exists()
 
 
-def test_sample_from_warns_on_meta_newer_than_synthesizer(
+def test_sample_from_meta_newer_than_synthesizer_is_hard_error(
         tmp_path, monkeypatch, capsys):
     """meta/encoders are written at training START, the synthesizer at the
     END: a later crashed run leaves the newest meta paired with an older
-    synthesizer.  _run_sample_from must say so instead of silently
-    decoding through mismatched artifacts."""
+    synthesizer.  Decoding through mismatched artifacts is silently wrong,
+    so _run_sample_from refuses (rc 2) unless --allow-meta-mismatch
+    downgrades the refusal to a warning."""
     import pickle
     import time
     from types import SimpleNamespace
 
-    import fed_tgan_tpu.data.decode as decode_mod
-    import fed_tgan_tpu.data.schema as schema_mod
-    import fed_tgan_tpu.runtime.checkpoint as ckpt_mod
+    import fed_tgan_tpu.serve.engine as serve_engine
+    import fed_tgan_tpu.serve.registry as serve_registry
     from fed_tgan_tpu import cli
 
     models = tmp_path / "models"
@@ -461,20 +461,36 @@ def test_sample_from_warns_on_meta_newer_than_synthesizer(
     os.utime(synth / "params.msgpack", (now - 100, now - 100))
     os.utime(meta_p, (now, now))
 
-    monkeypatch.setattr(
-        ckpt_mod, "load_synthesizer",
-        lambda d: SimpleNamespace(sample=lambda n, seed: None))
-    monkeypatch.setattr(
-        schema_mod.TableMeta, "load_json", staticmethod(lambda p: None))
-    monkeypatch.setattr(decode_mod, "decode_matrix",
-                        lambda m, meta, enc: pd.DataFrame({"a": [1, 2]}))
+    monkeypatch.setattr(serve_registry, "load_model",
+                        lambda art, source_dir=None: SimpleNamespace())
+
+    class FakeEngine:
+        def __init__(self, model, **kw):
+            pass
+
+        def sample_frame(self, n, seed=0, offset=0, condition=None):
+            return pd.DataFrame({"a": [1, 2]})
+
+    monkeypatch.setattr(serve_engine, "SamplingEngine", FakeEngine)
+
     args = SimpleNamespace(
         sample_from=str(tmp_path), sample_rows=2, seed=0,
-        out_dir=str(tmp_path / "out"), quiet=True)
-    assert cli._run_sample_from(args) == 0
-    assert "is newer than the saved" in capsys.readouterr().out
+        out_dir=str(tmp_path / "out"), quiet=True,
+        allow_meta_mismatch=False)
+    assert cli._run_sample_from(args) == 2
+    out = capsys.readouterr().out
+    assert "is newer than the saved" in out
+    assert "--allow-meta-mismatch" in out  # the message names the escape
+    assert not (tmp_path / "out" / "toy_synthesis_sampled.csv").exists()
 
-    # synthesizer newer than meta (the healthy case): no warning
+    # the escape hatch proceeds, but loudly
+    args.allow_meta_mismatch = True
+    assert cli._run_sample_from(args) == 0
+    assert "WARNING" in capsys.readouterr().out
+    assert (tmp_path / "out" / "toy_synthesis_sampled.csv").exists()
+
+    # synthesizer newer than meta (the healthy case): no warning, no error
+    args.allow_meta_mismatch = False
     os.utime(synth / "params.msgpack", (now + 100, now + 100))
     assert cli._run_sample_from(args) == 0
     assert "is newer than the saved" not in capsys.readouterr().out

@@ -1,0 +1,302 @@
+"""Serving subsystem: registry resolution, engine determinism contract,
+and the in-process HTTP service (hermetic: ephemeral ports, no sleeps).
+
+The expensive part — training the demo artifact — happens once per module
+(1 epoch, batch 50, embedding 16: seconds on CPU, compiles hit the
+persistent cache).  Every test here runs against that one artifact.
+"""
+
+import os
+import shutil
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.serve.engine import ConditionError, SamplingEngine
+from fed_tgan_tpu.serve.metrics import ServiceMetrics
+from fed_tgan_tpu.serve.registry import (
+    ArtifactError,
+    ModelRegistry,
+    load_model,
+    resolve_artifact,
+)
+from fed_tgan_tpu.serve.service import SamplingService, _Request, client_main
+
+pytestmark = pytest.mark.serve
+
+_silent = lambda *a, **k: None  # noqa: E731
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(tmp_path_factory):
+    from fed_tgan_tpu.serve.demo import build_demo_artifact
+
+    return build_demo_artifact(str(tmp_path_factory.mktemp("serve_artifact")))
+
+
+@pytest.fixture(scope="module")
+def model(artifact_dir):
+    return load_model(resolve_artifact(artifact_dir, log=_silent))
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    return SamplingEngine(model)
+
+
+@pytest.fixture(scope="module")
+def service(artifact_dir):
+    svc = SamplingService(
+        ModelRegistry(artifact_dir, log=_silent),
+        port=0, max_batch=4, queue_size=32, log=_silent,
+    ).start()
+    yield svc
+    svc.shutdown(drain=False)
+
+
+def _get(url, timeout=120):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_resolve_artifact_accepts_all_three_roots(artifact_dir):
+    """out-dir, models dir, and synthesizer dir all resolve to the same
+    artifact — the --sample-from contract the registry factored out."""
+    by_out = resolve_artifact(artifact_dir, log=_silent)
+    by_models = resolve_artifact(
+        os.path.join(artifact_dir, "models"), log=_silent)
+    by_synth = resolve_artifact(
+        os.path.join(artifact_dir, "models", "synthesizer"), log=_silent)
+    assert by_out == by_models == by_synth
+    assert by_out.name == "demo"
+
+
+def test_resolve_artifact_missing_raises_with_hint(tmp_path):
+    with pytest.raises(ArtifactError, match="train once"):
+        resolve_artifact(str(tmp_path), log=_silent)
+
+
+def test_model_id_is_content_hash(model, artifact_dir):
+    from fed_tgan_tpu.runtime.checkpoint import checkpoint_fingerprint
+
+    assert len(model.model_id) == 12
+    int(model.model_id, 16)  # hex
+    assert model.model_id == checkpoint_fingerprint(
+        os.path.join(artifact_dir, "models", "synthesizer"))
+
+
+def test_registry_hot_reload_swaps_on_new_generation(artifact_dir, tmp_path):
+    """A re-published checkpoint (same schema, new params) must be picked
+    up by maybe_reload, and the engine must adopt it WITHOUT dropping its
+    compiled programs (params are call arguments, not baked constants)."""
+    from fed_tgan_tpu.serve.demo import build_demo_artifact
+
+    root = str(tmp_path / "artifact")
+    shutil.copytree(artifact_dir, root)
+    reg = ModelRegistry(root, log=_silent)
+    first_id = reg.get().model_id
+    eng = SamplingEngine(reg.get())
+    assert reg.maybe_reload() is False  # nothing changed yet
+
+    # same data/schema (same seed), longer training => new checkpoint bytes
+    build_demo_artifact(root, epochs=2)
+    assert reg.maybe_reload() is True
+    assert reg.get().model_id != first_id
+    assert eng.adopt(reg.get()) is True  # programs kept: layout unchanged
+
+
+# ------------------------------------------------------------------ engine
+
+
+def test_engine_chunked_draws_match_one_shot(engine):
+    """The determinism contract: N rows in K offset-contiguous chunks are
+    bit-identical to one N-row draw, for any chunk boundaries (batch-
+    aligned or not)."""
+    whole = engine.sample_decoded(120, seed=5)
+    parts = np.concatenate([
+        engine.sample_decoded(40, seed=5, offset=0),
+        engine.sample_decoded(80, seed=5, offset=40),
+    ])
+    np.testing.assert_array_equal(whole, parts)
+    # an odd, batch-straddling window addresses the same virtual stream
+    np.testing.assert_array_equal(
+        whole[55:62], engine.sample_decoded(7, seed=5, offset=55))
+
+
+def test_engine_cold_vs_warm_identical(engine, model):
+    """A freshly-constructed engine (cold program cache) must reproduce a
+    warm engine's stream exactly — compilation state is not entropy."""
+    cold = SamplingEngine(model)
+    np.testing.assert_array_equal(
+        cold.sample_decoded(60, seed=9), engine.sample_decoded(60, seed=9))
+
+
+def test_engine_seeds_are_independent_streams(engine):
+    a = engine.sample_decoded(50, seed=1)
+    b = engine.sample_decoded(50, seed=2)
+    assert not np.array_equal(a, b)
+
+
+def test_engine_chunk_plan_buckets_are_powers_of_two(engine):
+    for total in (1, 3, 5, 128, 129, 300):
+        plan = engine._chunk_plan(0, total)
+        covered = 0
+        for start, steps in plan:
+            assert start == covered
+            assert steps <= engine.max_chunk_steps
+            assert steps & (steps - 1) == 0  # power of two
+            covered += steps
+        assert covered >= total
+    # bucketing bounds the compiled-program set: full blocks + pow2 tail
+    assert engine._chunk_plan(0, 300) == [(0, 128), (128, 128), (256, 64)]
+
+
+def test_engine_rejects_bad_args(engine):
+    with pytest.raises(ValueError, match="at least one row"):
+        engine.sample_decoded(0)
+    with pytest.raises(ValueError, match="must be >= 0"):
+        engine.sample_decoded(10, offset=-1)
+
+
+def test_engine_conditional_position_and_errors(engine):
+    spec = engine.spec
+    meta = engine.model.meta
+    names = list(meta.column_names)
+    pos = engine.resolve_condition("color", "green")
+    col_idx = names.index("color")
+    lo = int(spec.cond_offsets[col_idx])
+    assert lo <= pos < lo + int(spec.cond_sizes[col_idx])
+    # conditional draws are deterministic and differ from unconditional
+    a = engine.sample_decoded(50, seed=3, condition=pos)
+    np.testing.assert_array_equal(
+        a, engine.sample_decoded(50, seed=3, condition=pos))
+    assert np.isfinite(a).all()
+    assert not np.array_equal(a, engine.sample_decoded(50, seed=3))
+
+    with pytest.raises(ConditionError, match="unknown column"):
+        engine.resolve_condition("nope", "x")
+    with pytest.raises(ConditionError, match="continuous"):
+        engine.resolve_condition("amount", "1.0")
+    with pytest.raises(ConditionError):
+        engine.resolve_condition("color", "plaid")
+
+
+# ----------------------------------------------------------------- service
+
+
+def test_served_bytes_match_one_shot_cli_file(service, artifact_dir,
+                                              tmp_path):
+    """Acceptance: a served /sample response is byte-identical to the CSV
+    the one-shot --sample-from path writes for the same (rows, seed)."""
+    from types import SimpleNamespace
+
+    from fed_tgan_tpu import cli
+
+    served = _get(f"{service.url}/sample?rows=40&seed=7")
+    out_dir = str(tmp_path / "oneshot")
+    rc = cli._run_sample_from(SimpleNamespace(
+        sample_from=artifact_dir, sample_rows=40, seed=7,
+        out_dir=out_dir, quiet=True, allow_meta_mismatch=False))
+    assert rc == 0
+    with open(os.path.join(out_dir, "demo_synthesis_sampled.csv"),
+              "rb") as f:
+        assert f.read() == served
+
+
+def test_served_chunked_equals_one_request(service):
+    whole = _get(f"{service.url}/sample?rows=90&seed=4")
+    parts = (
+        _get(f"{service.url}/sample?rows=30&seed=4&offset=0")
+        + _get(f"{service.url}/sample?rows=60&seed=4&offset=30&header=0")
+    )
+    assert whole == parts
+
+
+def test_sample_client_chunked_equals_one_shot(service, tmp_path):
+    one, many = str(tmp_path / "one.csv"), str(tmp_path / "many.csv")
+    assert client_main(["--url", service.url, "--rows", "50", "--seed", "2",
+                        "--out", one]) == 0
+    assert client_main(["--url", service.url, "--rows", "50", "--seed", "2",
+                        "--chunks", "3", "--out", many]) == 0
+    with open(one, "rb") as f1, open(many, "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_healthz_and_metrics_endpoints(service):
+    import json
+
+    snap = json.loads(_get(f"{service.url}/healthz"))
+    assert snap["status"] == "ok"
+    assert snap["model_id"] == service.registry.get().model_id
+    assert snap["model_name"] == "demo"
+    assert snap["requests_total"] >= 1  # earlier tests sampled
+
+    text = _get(f"{service.url}/metrics").decode()
+    assert "# TYPE fed_tgan_serving_requests_total counter" in text
+    assert "fed_tgan_serving_batch_occupancy" in text
+    assert "fed_tgan_serving_rows_per_sec" in text
+
+
+def test_http_errors(service):
+    for path, want in [("/sample?rows=0", 400),
+                       ("/sample?rows=5&offset=-1", 400),
+                       ("/sample?rows=5&column=nope&value=x", 400),
+                       ("/nothing", 404)]:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(f"{service.url}{path}")
+        assert err.value.code == want
+
+
+def test_submit_sheds_when_queue_full_or_draining(artifact_dir):
+    """Bounded queue behavior, no HTTP/no worker needed: the worker never
+    starts, so the first request parks in the 1-slot queue and the second
+    must shed."""
+    svc = SamplingService(ModelRegistry(artifact_dir, log=_silent),
+                          queue_size=1, log=_silent)  # never start()ed
+    assert svc.submit(_Request(n=1, seed=0, offset=0, condition=None,
+                               header=True)) is True
+    assert svc.submit(_Request(n=1, seed=0, offset=0, condition=None,
+                               header=True)) is False
+    assert svc.metrics.shed_total == 1
+    svc._draining.set()
+    assert svc.submit(_Request(n=1, seed=0, offset=0, condition=None,
+                               header=True)) is False
+
+
+def test_shutdown_drains_queued_requests(artifact_dir):
+    """Graceful drain: requests already accepted are answered before the
+    worker exits, even though no new ones are admitted."""
+    svc = SamplingService(ModelRegistry(artifact_dir, log=_silent),
+                          port=0, log=_silent).start()
+    req = _Request(n=10, seed=0, offset=0, condition=None, header=True)
+    assert svc.submit(req)
+    svc.shutdown(drain=True)
+    assert req.done.is_set()
+    assert req.status == 200 and req.result is not None
+    assert not svc.submit(_Request(n=1, seed=0, offset=0, condition=None,
+                                   header=True))
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_metrics_occupancy_and_quantiles():
+    m = ServiceMetrics()
+    m.record_batch(3)
+    for lat in (0.010, 0.020, 0.030):
+        m.record_request(lat, rows=100)
+    snap = m.snapshot(queue_depth=2)
+    assert snap["requests_total"] == 3
+    assert snap["rows_total"] == 300
+    assert snap["batches_total"] == 1
+    assert snap["batch_occupancy"] == 3.0  # 3 requests in 1 worker cycle
+    assert snap["queue_depth"] == 2
+    assert snap["latency_p50_ms"] == 20.0
+    text = m.render_prometheus()
+    assert "# TYPE fed_tgan_serving_batch_occupancy gauge" in text
+    assert "fed_tgan_serving_batch_occupancy 3.0" in text

@@ -48,6 +48,29 @@ def test_cond_sampler_distributions():
     assert np.abs(freq - want).max() < 0.05
 
 
+def test_cond_sampler_all_zero_counts_falls_back_to_uniform():
+    """A column whose counts are all zero (empty or fully-quarantined
+    shard) used to hit logf/logf.sum() = 0/0 and fill p_train with NaN —
+    poisoning every conditional draw.  It must fall back to uniform over
+    the column's options, leaving other columns untouched."""
+    spec, data = _spec_and_onehots(sizes=(3, 4))
+    counts = CondSampler.count_matrix(data, spec)
+    counts[1, :] = 0.0  # second column never observed
+    cs = CondSampler.from_counts(counts, spec)
+    p_train = np.asarray(cs.p_train)
+    p_emp = np.asarray(cs.p_empirical)
+    assert np.isfinite(p_train).all() and np.isfinite(p_emp).all()
+    np.testing.assert_allclose(p_train[1], [0.25] * 4)
+    np.testing.assert_allclose(p_emp[1], [0.25] * 4)
+    # the observed column keeps its real log-frequency distribution
+    want = np.log(counts[0, :3] + 1.0)
+    np.testing.assert_allclose(p_train[0, :3], want / want.sum())
+    # draws stay valid one-hots (no NaN-propagated garbage)
+    cond, mask, _, _ = cs.sample_train(jax.random.key(0), 256)
+    assert (np.asarray(cond).sum(axis=1) == 1).all()
+    assert (np.asarray(mask).sum(axis=1) == 1).all()
+
+
 def test_row_sampler_returns_matching_rows():
     spec, data = _spec_and_onehots()
     rs = RowSampler.from_data(data, spec)
