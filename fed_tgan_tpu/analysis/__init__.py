@@ -1,0 +1,36 @@
+"""Correctness tooling: static JAX lint (jaxlint) + runtime sanitizers.
+
+Two prongs, one goal -- keep the hot paths provably clean:
+
+* :mod:`fed_tgan_tpu.analysis.lint` -- stdlib-AST rules J01-J05 (host
+  syncs in hot loops, PRNG key reuse, recompile hazards, numpy-in-jit,
+  unguarded shared state) with a checked-in ratcheting baseline.
+  Run ``python -m fed_tgan_tpu.analysis``.
+* :mod:`fed_tgan_tpu.analysis.sanitizers` -- opt-in runtime guards:
+  transfer guards around designated hot regions, a ``log_compiles``
+  driven compile counter with per-program budgets, NaN debugging.
+  Enabled by ``--sanitize`` on the train/serve CLIs.
+
+This ``__init__`` stays import-light (no JAX, no numpy) so the lint
+gate and the CLI start instantly.
+"""
+
+from fed_tgan_tpu.analysis.lint import (  # noqa: F401
+    DEFAULT_BASELINE_PATH,
+    Finding,
+    LintError,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+
+__all__ = [
+    "DEFAULT_BASELINE_PATH",
+    "Finding",
+    "LintError",
+    "apply_baseline",
+    "load_baseline",
+    "run_lint",
+    "save_baseline",
+]

@@ -1,0 +1,97 @@
+"""``python -m fed_tgan_tpu.analysis`` -- the jaxlint CLI.
+
+Exit codes: 0 clean (or all findings baselined), 1 new findings,
+2 usage / parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from fed_tgan_tpu.analysis.lint import (
+    DEFAULT_BASELINE_PATH,
+    LintError,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+from fed_tgan_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m fed_tgan_tpu.analysis",
+        description="JAX-aware lint (J01-J05) over fed_tgan_tpu",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE_PATH,
+                    help="baseline JSON of accepted findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline to the current finding set")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    rules = None
+    if args.rules:
+        try:
+            rules = [RULES_BY_ID[r.strip()]
+                     for r in args.rules.split(",") if r.strip()]
+        except KeyError as exc:
+            print(f"jaxlint: unknown rule {exc} "
+                  f"(have {sorted(RULES_BY_ID)})", file=sys.stderr)
+            return 2
+
+    try:
+        findings = run_lint(args.paths or None, rules=rules)
+    except LintError as exc:
+        print(f"jaxlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.baseline_update:
+        path = save_baseline(findings, args.baseline)
+        print(f"jaxlint: baseline updated: {len(findings)} finding(s) "
+              f"-> {path}")
+        return 0
+
+    try:
+        baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    except LintError as exc:
+        print(f"jaxlint: {exc}", file=sys.stderr)
+        return 2
+    new, old, stale = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "new": [f.key for f in new],
+            "baselined": [f.key for f in old],
+            "stale_baseline": sorted(stale),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for key in sorted(stale):
+            print(f"jaxlint: stale baseline entry (fixed? run "
+                  f"--baseline-update to drop): {key}")
+        print(f"jaxlint: {len(findings)} finding(s): {len(new)} new, "
+              f"{len(old)} baselined, {len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'} "
+              f"[rules: {', '.join(r.rule_id for r in (rules or ALL_RULES))}]")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,24 @@
+"""jaxlint rule registry.
+
+Each rule exposes ``rule_id``, ``title``, ``hint`` and
+``check(module) -> iter[(rule_id, line, message, hint)]``.
+"""
+
+from fed_tgan_tpu.analysis.rules.host_sync import HostSyncRule
+from fed_tgan_tpu.analysis.rules.numpy_in_jit import NumpyInJitRule
+from fed_tgan_tpu.analysis.rules.prng_reuse import PrngReuseRule
+from fed_tgan_tpu.analysis.rules.recompile import RecompileRule
+from fed_tgan_tpu.analysis.rules.shared_state import SharedStateRule
+
+ALL_RULES = (
+    HostSyncRule(),
+    PrngReuseRule(),
+    RecompileRule(),
+    NumpyInJitRule(),
+    SharedStateRule(),
+)
+
+RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID", "HostSyncRule", "PrngReuseRule",
+           "RecompileRule", "NumpyInJitRule", "SharedStateRule"]

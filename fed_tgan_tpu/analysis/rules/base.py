@@ -1,0 +1,253 @@
+"""Shared AST machinery for the jaxlint rules.
+
+Everything here is plain-stdlib AST analysis: no JAX import, no tracing.
+The helpers encode the few JAX-shaped facts the rules agree on:
+
+* what a *jit producer* looks like (``jax.jit``, ``pmap``, ``shard_map``,
+  the repo's ``*_epoch_fn`` / ``_program`` caches) so taint can seed from
+  "this value came out of a compiled program";
+* how to resolve which plain functions end up wrapped by ``jax.jit``
+  (decorators, ``partial(jax.jit, ...)``, ``jax.jit(name)`` call sites,
+  one hop through ``shard_map``) together with their static arguments.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+#: Callee spellings whose *result* (or whose call result) is a compiled
+#: program output.  Matched against the dotted form of the callee, where
+#: nested calls collapse to "()" -- e.g. ``self._epoch_fn_for(n)(x)``
+#: has the dotted callee ``self._epoch_fn_for()``.
+JIT_PRODUCER_RE = re.compile(
+    r"(?:^|\.)(?:jit|pjit|pmap)\b|epoch_fn|shard_map|(?:^|\.)_program\b"
+)
+
+#: ``jax.tree.map``-style spellings (first arg callable, rest are trees).
+TREE_MAP_NAMES = {
+    "jax.tree.map",
+    "jax.tree_util.tree_map",
+    "tree.map",
+    "tree_map",
+    "tree_util.tree_map",
+}
+
+#: Numpy module aliases as used across the repo.
+NUMPY_PREFIXES = ("np.", "numpy.", "onp.")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Dotted-name form of a callee expression.
+
+    ``jax.random.split`` -> "jax.random.split"; a call in the chain
+    collapses to "()": ``self._epoch_fn_for(n)(x)`` resolves its outer
+    callee to "self._epoch_fn_for()".  Unresolvable shapes yield None,
+    except attribute access on a complex base which keeps the attribute
+    name alone (enough for ``.item()`` detection on subscripted values).
+    """
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        base = dotted(node.func)
+        return f"{base}()" if base else None
+    return None
+
+
+def assigned_names(stmts) -> set:
+    """Every name (re)bound anywhere inside ``stmts``: plain/aug/ann
+    assignments, for-targets, with-as, walrus, tuple unpacks."""
+    out: set = set()
+
+    def bind(target):
+        if isinstance(target, ast.Name):
+            out.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                bind(elt)
+        elif isinstance(target, ast.Starred):
+            bind(target.value)
+
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    bind(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                bind(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                bind(node.target)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                bind(node.optional_vars)
+            elif isinstance(node, ast.NamedExpr):
+                bind(node.target)
+    return out
+
+
+def names_in(node: ast.AST) -> set:
+    """All ``Name`` identifiers appearing anywhere under ``node``."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def const_str_tuple(node) -> Optional[tuple]:
+    """A constant str/int or tuple/list thereof, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (str, int)):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, (str, int))):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    return None
+
+
+@dataclass
+class JittedFn:
+    """A function whose body runs under ``jax.jit`` (possibly through one
+    ``shard_map`` hop), with its traced-vs-static parameter split."""
+
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    dynamic_params: set = field(default_factory=set)
+    #: True when static-argument kwargs could not be parsed -- rules
+    #: should then skip the function rather than risk false positives.
+    opaque_statics: bool = False
+
+
+def _decorator_jit_statics(dec) -> Optional[tuple]:
+    """(static_names, static_nums, opaque) if ``dec`` marks the function
+    as jitted, else None."""
+    d = dotted(dec) or ""
+    if re.search(r"(?:^|\.)(?:jit|pjit)$", d):
+        return (set(), set(), False)
+    if isinstance(dec, ast.Call):
+        fd = dotted(dec.func) or ""
+        is_partial = re.search(r"(?:^|\.)partial$", fd) is not None
+        inner = dotted(dec.args[0]) if (is_partial and dec.args) else None
+        if (is_partial and inner
+                and re.search(r"(?:^|\.)(?:jit|pjit)$", inner)) or \
+                re.search(r"(?:^|\.)(?:jit|pjit)$", fd):
+            names: set = set()
+            nums: set = set()
+            opaque = False
+            for kw in dec.keywords:
+                if kw.arg == "static_argnames":
+                    vals = const_str_tuple(kw.value)
+                    if vals is None:
+                        opaque = True
+                    else:
+                        names |= set(vals)
+                elif kw.arg == "static_argnums":
+                    vals = const_str_tuple(kw.value)
+                    if vals is None:
+                        opaque = True
+                    else:
+                        nums |= set(vals)
+            return (names, nums, opaque)
+    return None
+
+
+def _fn_params(fn) -> list:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def _make_jitted(fn, static_names=(), static_nums=(), opaque=False) -> JittedFn:
+    if isinstance(fn, ast.Lambda):
+        params = [a.arg for a in fn.args.args]
+    else:
+        params = _fn_params(fn)
+    positional = [p for p in params if p not in ("self", "cls")]
+    statics = set(static_names)
+    for i in static_nums:
+        if isinstance(i, int) and 0 <= i < len(positional):
+            statics.add(positional[i])
+    dynamic = {p for p in positional if p not in statics}
+    return JittedFn(node=fn, dynamic_params=dynamic, opaque_statics=opaque)
+
+
+def jitted_functions(tree: ast.Module) -> list:
+    """Functions in ``tree`` that end up wrapped by ``jax.jit``.
+
+    Covers: ``@jax.jit`` / ``@partial(jax.jit, static_arg...)``
+    decorators, ``jax.jit(fn)`` call sites on a local def, and one
+    resolution hop through ``name = shard_map(fn, ...)`` /
+    ``name = partial(fn, ...)`` before the ``jax.jit(name)`` call.
+    """
+    defs: dict = {}
+    assigns: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            assigns[node.targets[0].id] = node.value
+
+    out: list = []
+    seen: set = set()
+
+    def add(fn, names=(), nums=(), opaque=False):
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append(_make_jitted(fn, names, nums, opaque))
+
+    for fn in defs.values():
+        for dec in fn.decorator_list:
+            statics = _decorator_jit_statics(dec)
+            if statics is not None:
+                add(fn, *statics)
+
+    def resolve(name: str, depth: int = 0):
+        if name in defs:
+            return defs[name]
+        if depth < 1 and name in assigns:
+            call = assigns[name]
+            d = dotted(call.func) or ""
+            if re.search(r"shard_map|pmap|(?:^|\.)partial$", d) and call.args:
+                inner = call.args[0]
+                if isinstance(inner, ast.Name):
+                    return resolve(inner.id, depth + 1)
+                if isinstance(inner, ast.Lambda):
+                    return inner
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func) or ""
+        if not re.search(r"(?:^|\.)(?:jit|pjit)$", d) or not node.args:
+            continue
+        target = node.args[0]
+        names: set = set()
+        nums: set = set()
+        opaque = False
+        for kw in node.keywords:
+            if kw.arg == "static_argnames":
+                vals = const_str_tuple(kw.value)
+                names |= set(vals) if vals is not None else set()
+                opaque = opaque or vals is None
+            elif kw.arg == "static_argnums":
+                vals = const_str_tuple(kw.value)
+                nums |= set(vals) if vals is not None else set()
+                opaque = opaque or vals is None
+        if isinstance(target, ast.Lambda):
+            add(target, names, nums, opaque)
+        elif isinstance(target, ast.Name):
+            fn = resolve(target.id)
+            if fn is not None:
+                add(fn, names, nums, opaque)
+    return out

@@ -1,0 +1,350 @@
+"""J01 -- blocking device->host sync inside a hot loop.
+
+Tracks taint from *jit producers* (``jax.jit`` results, the repo's
+``_epoch_fn_for``/``_program`` caches, ``shard_map``/``pmap``) through
+assignments, subscripts, arithmetic, and tuple unpacks.  A sink is any
+per-iteration host materialisation of a tainted value -- ``.item()``,
+``float()`` / ``int()``, any ``np.*`` call, or ``jax.tree.map`` with a
+host-pulling mapper -- lexically inside a ``for``/``while``/comprehension,
+or inside a function that is itself called from such a loop (one level of
+intra-module interprocedural propagation, enough to catch helpers like
+``FederatedTrainer._check_finite``).
+
+The sanctioned fix idiom is *not* flagged: ``jax.device_get(tree)`` is an
+explicit, batched transfer, and its result (plain numpy) launders the
+taint, so post-transfer ``np.*`` massaging stays clean.  ``bool(flag)``
+is likewise exempt: a single-scalar "decide on host" sync, usually
+preceded by ``copy_to_host_async``, is the designed control-flow idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from fed_tgan_tpu.analysis.rules.base import (
+    JIT_PRODUCER_RE,
+    NUMPY_PREFIXES,
+    TREE_MAP_NAMES,
+    dotted,
+)
+
+RULE_ID = "J01"
+HINT = ("batch the per-iteration host pulls into one explicit "
+        "jax.device_get(...) per iteration (or defer them past the loop); "
+        "pair decide-on-host scalars with .copy_to_host_async()")
+
+#: Calls whose result is host-side regardless of inputs (taint launder).
+_LAUNDER_NAMES = {"float", "int", "bool", "str", "len", "repr",
+                  "jax.device_get", "device_get"}
+
+
+@dataclass
+class _FnInfo:
+    node: ast.AST
+    params: list
+    tainted_params: set = field(default_factory=set)
+    hot: bool = False  # called (transitively) from inside a loop
+
+
+def _local_fns(tree: ast.Module) -> dict:
+    out: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            params = [a.arg for a in args.posonlyargs + args.args
+                      if a.arg not in ("self", "cls")]
+            out[node.name] = _FnInfo(node=node, params=params)
+    return out
+
+
+class _Scanner:
+    """One pass over one function body (or the module toplevel)."""
+
+    def __init__(self, info, fns, module_taint, jitted_names, collect):
+        self.info = info
+        self.fns = fns
+        self.taint = set(module_taint) | set(info.tainted_params)
+        self.jitted_names = jitted_names
+        self.collect = collect
+        self.findings: list = []
+        self.callsites: list = []
+
+    # -------------------------------------------------------- taint eval
+
+    def _is_launder(self, d: str) -> bool:
+        return (d in _LAUNDER_NAMES
+                or d.startswith(NUMPY_PREFIXES)
+                or d.endswith(".item")
+                or d.endswith(".tolist"))
+
+    def _tainted(self, e) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.taint
+        if isinstance(e, ast.Attribute):
+            return self._tainted(e.value)
+        if isinstance(e, ast.Subscript):
+            return self._tainted(e.value)
+        if isinstance(e, ast.Starred):
+            return self._tainted(e.value)
+        if isinstance(e, ast.Call):
+            d = dotted(e.func) or ""
+            if self._is_launder(d):
+                return False
+            if JIT_PRODUCER_RE.search(d):
+                return True
+            name = d[5:] if d.startswith("self.") else d
+            if name in self.jitted_names:
+                return True
+            if isinstance(e.func, ast.Attribute) and self._tainted(e.func.value):
+                return True  # method on a tainted object (.items(), ...)
+            if name in self.fns and any(self._tainted(a) for a in e.args):
+                return True  # local helper fed tainted data
+            return False
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._tainted(x) for x in e.elts)
+        if isinstance(e, ast.Dict):
+            return any(self._tainted(v) for v in e.values) or \
+                any(self._tainted(k) for k in e.keys if k is not None)
+        if isinstance(e, ast.BinOp):
+            return self._tainted(e.left) or self._tainted(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self._tainted(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self._tainted(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            return self._tainted(e.left) or \
+                any(self._tainted(c) for c in e.comparators)
+        if isinstance(e, ast.IfExp):
+            return self._tainted(e.body) or self._tainted(e.orelse)
+        if isinstance(e, ast.NamedExpr):
+            t = self._tainted(e.value)
+            if t:
+                self._bind(e.target)
+            return t
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            for gen in e.generators:
+                if self._tainted(gen.iter):
+                    self._bind(gen.target)
+            return self._tainted(e.elt)
+        if isinstance(e, ast.DictComp):
+            for gen in e.generators:
+                if self._tainted(gen.iter):
+                    self._bind(gen.target)
+            return self._tainted(e.key) or self._tainted(e.value)
+        return False
+
+    def _bind(self, target) -> None:
+        if isinstance(target, ast.Name):
+            self.taint.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value)
+
+    # ------------------------------------------------------------- sinks
+
+    def _finding(self, node, message) -> None:
+        if self.collect:
+            self.findings.append((node.lineno, message))
+
+    def _check_call(self, call, in_loop) -> None:
+        self._register_callsite(call, in_loop)
+        hot = in_loop or self.info.hot
+        if not hot:
+            return
+        d = dotted(call.func) or ""
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not call.args and self._tainted(func.value):
+            self._finding(call, ".item() on a jitted output syncs the "
+                                "device every iteration")
+            return
+        if d in ("float", "int") and len(call.args) == 1 \
+                and self._tainted(call.args[0]):
+            self._finding(call, f"{d}() on a jitted output blocks on a "
+                                "device sync every iteration")
+            return
+        if d.startswith(NUMPY_PREFIXES) and \
+                any(self._tainted(a) for a in call.args):
+            self._finding(call, f"{d}() pulls a jitted output to host "
+                                "every iteration")
+            return
+        if d in TREE_MAP_NAMES and len(call.args) >= 2 and \
+                any(self._tainted(a) for a in call.args[1:]):
+            mapper = call.args[0]
+            md = dotted(mapper) or ""
+            if md.startswith(NUMPY_PREFIXES) or md in ("float", "int"):
+                self._finding(call, f"{d}({md}, ...) pulls every tree "
+                                    "leaf to host separately")
+            elif isinstance(mapper, ast.Lambda):
+                lam_params = {a.arg for a in mapper.args.args}
+                added = lam_params - self.taint
+                self.taint |= lam_params
+                before = len(self.findings)
+                self._scan_expr(mapper.body, True)
+                self.taint -= added
+                if self.collect and len(self.findings) > before:
+                    # re-anchor lambda-body findings to the map call
+                    self.findings[before:] = [
+                        (call.lineno, "tree.map with a host-pulling "
+                                      "mapper materialises every leaf "
+                                      "separately")]
+
+    def _register_callsite(self, call, in_loop) -> None:
+        func = call.func
+        name = None
+        if isinstance(func, ast.Name) and func.id in self.fns:
+            name = func.id
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("self", "cls") \
+                and func.attr in self.fns:
+            name = func.attr
+        if name is None:
+            return
+        pos = [self._tainted(a) for a in call.args
+               if not isinstance(a, ast.Starred)]
+        kw = {k.arg: self._tainted(k.value)
+              for k in call.keywords if k.arg}
+        self.callsites.append(
+            (name, pos, kw, in_loop or self.info.hot))
+
+    # ----------------------------------------------------- tree walking
+
+    def _scan_expr(self, e, in_loop) -> None:
+        if e is None or not isinstance(e, ast.AST):
+            return
+        if isinstance(e, (ast.ListComp, ast.SetComp,
+                          ast.GeneratorExp, ast.DictComp)):
+            for gen in e.generators:
+                self._scan_expr(gen.iter, in_loop)
+                if self._tainted(gen.iter):
+                    self._bind(gen.target)
+                for cond in gen.ifs:
+                    self._scan_expr(cond, True)
+            if isinstance(e, ast.DictComp):
+                self._scan_expr(e.key, True)
+                self._scan_expr(e.value, True)
+            else:
+                self._scan_expr(e.elt, True)
+            return
+        if isinstance(e, ast.Lambda):
+            return  # only entered via the tree.map special case
+        if isinstance(e, ast.Call):
+            self._check_call(e, in_loop)
+            self._scan_expr(e.func, in_loop)
+            for a in e.args:
+                self._scan_expr(a, in_loop)
+            for k in e.keywords:
+                self._scan_expr(k.value, in_loop)
+            return
+        for child in ast.iter_child_nodes(e):
+            self._scan_expr(child, in_loop)
+
+    def _scan_stmts(self, stmts, in_loop) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue  # nested defs are scanned as their own functions
+            if isinstance(s, ast.Assign):
+                self._scan_expr(s.value, in_loop)
+                if isinstance(s.value, ast.Call):
+                    d = dotted(s.value.func) or ""
+                    if JIT_PRODUCER_RE.search(d):
+                        for t in s.targets:
+                            if isinstance(t, ast.Name):
+                                self.jitted_names.add(t.id)
+                if self._tainted(s.value):
+                    for t in s.targets:
+                        self._bind(t)
+            elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+                if s.value is not None:
+                    self._scan_expr(s.value, in_loop)
+                    if self._tainted(s.value):
+                        self._bind(s.target)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                self._scan_expr(s.iter, in_loop)
+                if self._tainted(s.iter):
+                    self._bind(s.target)
+                self._scan_stmts(s.body, True)
+                self._scan_stmts(s.orelse, True)
+            elif isinstance(s, ast.While):
+                self._scan_expr(s.test, True)
+                self._scan_stmts(s.body, True)
+                self._scan_stmts(s.orelse, in_loop)
+            elif isinstance(s, ast.If):
+                self._scan_expr(s.test, in_loop)
+                self._scan_stmts(s.body, in_loop)
+                self._scan_stmts(s.orelse, in_loop)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    self._scan_expr(item.context_expr, in_loop)
+                    if item.optional_vars is not None and \
+                            self._tainted(item.context_expr):
+                        self._bind(item.optional_vars)
+                self._scan_stmts(s.body, in_loop)
+            elif isinstance(s, ast.Try):
+                self._scan_stmts(s.body, in_loop)
+                for h in s.handlers:
+                    self._scan_stmts(h.body, in_loop)
+                self._scan_stmts(s.orelse, in_loop)
+                self._scan_stmts(s.finalbody, in_loop)
+            else:
+                for child in ast.iter_child_nodes(s):
+                    self._scan_expr(child, in_loop)
+
+    def run(self, body) -> None:
+        self._scan_stmts(body, False)
+
+
+class HostSyncRule:
+    rule_id = RULE_ID
+    title = "host sync in hot path"
+    hint = HINT
+
+    #: fixpoint sweeps: 1 seeds call sites, 2 propagates hot/taint one
+    #: hop, 3 reaches helpers-of-helpers and collects findings.
+    _PASSES = 3
+
+    def check(self, mod) -> Iterator:
+        tree = mod.tree
+        fns = _local_fns(tree)
+        module_info = _FnInfo(node=tree, params=[])
+        jitted_names: set = set()
+        all_findings: dict = {}
+
+        for sweep in range(self._PASSES):
+            collect = sweep == self._PASSES - 1
+            module_taint: set = set()
+            scanners = []
+
+            mscan = _Scanner(module_info, fns, set(), jitted_names, collect)
+            mscan.run(tree.body)
+            module_taint = mscan.taint
+            scanners.append(mscan)
+
+            for info in fns.values():
+                sc = _Scanner(info, fns, module_taint, jitted_names, collect)
+                sc.run(info.node.body)
+                scanners.append(sc)
+
+            for sc in scanners:
+                for name, pos, kw, hot in sc.callsites:
+                    callee = fns[name]
+                    if hot:
+                        callee.hot = True
+                    for i, tainted in enumerate(pos):
+                        if tainted and i < len(callee.params):
+                            callee.tainted_params.add(callee.params[i])
+                    for k, tainted in kw.items():
+                        if tainted and k in callee.params:
+                            callee.tainted_params.add(k)
+                if collect:
+                    for line, message in sc.findings:
+                        all_findings.setdefault(line, message)
+
+        for line in sorted(all_findings):
+            yield (self.rule_id, line, all_findings[line], self.hint)

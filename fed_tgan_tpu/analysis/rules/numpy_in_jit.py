@@ -1,0 +1,72 @@
+"""J04 -- host ``numpy`` applied to traced values inside a jitted function.
+
+``np.*`` executes eagerly at trace time: fed a tracer it either crashes
+(`TracerArrayConversionError`) or silently bakes a stale constant into
+the compiled program.  The rule resolves jit-wrapped functions (through
+one ``shard_map`` hop, so fused epoch bodies are covered), taints their
+non-static parameters plus anything assigned from them, and flags any
+``np.`` / ``numpy.`` call whose arguments touch tainted names.
+
+``np.*`` on constants (lookup tables, shape tuples) is trace-time
+constant folding and stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fed_tgan_tpu.analysis.rules.base import (
+    NUMPY_PREFIXES,
+    dotted,
+    jitted_functions,
+    names_in,
+)
+
+RULE_ID = "J04"
+HINT = ("inside jit, use jax.numpy (jnp) on traced values; reserve np.* "
+        "for trace-time constants")
+
+
+class NumpyInJitRule:
+    rule_id = RULE_ID
+    title = "numpy inside jit"
+    hint = HINT
+
+    def check(self, mod) -> Iterator:
+        findings: dict = {}
+        for jf in jitted_functions(mod.tree):
+            body = jf.node.body
+            stmts = body if isinstance(body, list) else []
+            tainted = set(jf.dynamic_params)
+            for _ in range(2):  # propagate through simple assignments
+                for stmt in stmts:
+                    for node in ast.walk(stmt):
+                        if isinstance(node, ast.Assign) and \
+                                names_in(node.value) & tainted:
+                            for t in node.targets:
+                                tainted |= {n.id for n in ast.walk(t)
+                                            if isinstance(n, ast.Name)}
+                        elif isinstance(node, (ast.For, ast.AsyncFor)) and \
+                                names_in(node.iter) & tainted:
+                            tainted |= {n.id for n in ast.walk(node.target)
+                                        if isinstance(n, ast.Name)}
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = dotted(node.func) or ""
+                    if not d.startswith(NUMPY_PREFIXES):
+                        continue
+                    touched = set()
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        touched |= names_in(a)
+                    if touched & tainted:
+                        findings.setdefault(
+                            node.lineno,
+                            f"{d}() runs on host at trace time; on a "
+                            "traced value it crashes or bakes in a stale "
+                            "constant")
+        for line in sorted(findings):
+            yield (self.rule_id, line, findings[line], self.hint)

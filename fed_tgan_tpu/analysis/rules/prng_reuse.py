@@ -1,0 +1,219 @@
+"""J02 -- the same PRNG key consumed by two ``jax.random.*`` draws.
+
+JAX keys are splittable, not stateful: passing one key to two samplers
+yields *correlated* (often identical) streams.  The rule tracks a
+per-identity generation counter -- rebinding a name bumps its
+generation -- and flags (a) two consumptions of the same ``(identity,
+generation)`` on one control-flow path, and (b) consumption inside a
+loop of a key that is never rebound within that loop (the classic
+"same key every iteration" bug).
+
+Derivers (``split`` / ``fold_in`` / ``key`` / ``PRNGKey`` ...) are not
+consumptions; ``if``/``else`` branches are checked independently so a
+key consumed once per exclusive branch stays clean; ``keys[i]`` with a
+non-constant index is assumed fresh per iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from fed_tgan_tpu.analysis.rules.base import assigned_names, dotted
+
+RULE_ID = "J02"
+HINT = ("derive a fresh key per draw: `ka, kb = jax.random.split(key)` or "
+        "`jax.random.fold_in(key, step)` inside loops")
+
+#: ``jax.random`` functions that *produce* key material rather than
+#: consuming it for a draw.
+_DERIVERS = {"split", "fold_in", "key", "PRNGKey", "wrap_key_data",
+             "key_data", "clone", "key_impl"}
+
+_KEY_PREFIXES = ("jax.random.", "jrandom.", "jr.")
+
+
+def _consumed_key(call) -> Optional[ast.AST]:
+    """The key argument when ``call`` is a consuming jax.random draw."""
+    d = dotted(call.func) or ""
+    if "jax.random." not in d and not d.startswith(("jrandom.", "jr.")):
+        return None
+    last = d.rsplit(".", 1)[-1]
+    if last in _DERIVERS:
+        return None
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return None
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _ident(e) -> Optional[tuple]:
+    if isinstance(e, ast.Name):
+        return ("n", e.id)
+    if isinstance(e, ast.Subscript) and isinstance(e.value, ast.Name):
+        sl = e.slice
+        if isinstance(sl, ast.Constant):
+            return ("s", e.value.id, repr(sl.value))
+        return ("s", e.value.id, None)  # dynamic index: assumed varying
+    if isinstance(e, ast.Attribute):
+        d = dotted(e)
+        return ("a", d) if d else None
+    return None
+
+
+class _FnScan:
+    def __init__(self):
+        self.gen: dict = {}
+        self.findings: dict = {}
+
+    def _bump(self, target) -> None:
+        ident = _ident(target)
+        if ident is not None:
+            self.gen[ident] = self.gen.get(ident, 0) + 1
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bump(elt)
+        elif isinstance(target, ast.Starred):
+            self._bump(target.value)
+        elif isinstance(target, ast.Name):
+            # rebinding a name invalidates subscript identities too
+            stale = [i for i in self.gen
+                     if i[0] == "s" and i[1] == target.id]
+            for i in stale:
+                self.gen[i] += 1
+
+    def _consume(self, key_expr, line, uses, loop_names) -> None:
+        ident = _ident(key_expr)
+        if ident is None or (ident[0] == "s" and ident[2] is None):
+            return
+        slot = (ident, self.gen.get(ident, 0))
+        if slot in uses:
+            self.findings.setdefault(
+                line, "key already consumed by the jax.random call on "
+                      f"line {uses[slot]}")
+            return
+        if loop_names is not None and ident[0] != "a":
+            base = ident[1]
+            if base not in loop_names:
+                self.findings.setdefault(
+                    line, f"key `{base}` is consumed every loop "
+                          "iteration without being rebound in the loop")
+                # fall through: still record the use
+        uses[slot] = line
+
+    def _scan_calls(self, e, uses, loop_names) -> None:
+        """In-order walk of an expression, consuming keys left-to-right."""
+        if e is None or not isinstance(e, ast.AST):
+            return
+        if isinstance(e, ast.Call):
+            self._scan_calls(e.func, uses, loop_names)
+            for a in e.args:
+                self._scan_calls(a, uses, loop_names)
+            for k in e.keywords:
+                self._scan_calls(k.value, uses, loop_names)
+            key = _consumed_key(e)
+            if key is not None:
+                self._consume(key, e.lineno, uses, loop_names)
+            return
+        if isinstance(e, (ast.ListComp, ast.SetComp,
+                          ast.GeneratorExp, ast.DictComp)):
+            inner = set(loop_names or set())
+            for gen in e.generators:
+                self._scan_calls(gen.iter, uses, loop_names)
+                inner |= {n.id for n in ast.walk(gen.target)
+                          if isinstance(n, ast.Name)}
+            parts = [e.key, e.value] if isinstance(e, ast.DictComp) \
+                else [e.elt]
+            for p in parts:
+                self._scan_calls(p, uses, inner)
+            return
+        if isinstance(e, ast.Lambda):
+            return
+        for child in ast.iter_child_nodes(e):
+            self._scan_calls(child, uses, loop_names)
+
+    def scan(self, stmts, uses, loop_names) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, ast.Assign):
+                self._scan_calls(s.value, uses, loop_names)
+                for t in s.targets:
+                    self._bump(t)
+            elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+                if s.value is not None:
+                    self._scan_calls(s.value, uses, loop_names)
+                self._bump(s.target)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                self._scan_calls(s.iter, uses, loop_names)
+                body_names = assigned_names(s.body) | {
+                    n.id for n in ast.walk(s.target)
+                    if isinstance(n, ast.Name)}
+                if loop_names is not None:
+                    body_names |= set()
+                self.scan(s.body, uses, body_names)
+                self.scan(s.orelse, uses, loop_names)
+            elif isinstance(s, ast.While):
+                body_names = assigned_names(s.body)
+                self._scan_calls(s.test, uses, body_names)
+                self.scan(s.body, uses, body_names)
+                self.scan(s.orelse, uses, loop_names)
+            elif isinstance(s, ast.If):
+                self._scan_calls(s.test, uses, loop_names)
+                a = dict(uses)
+                self.scan(s.body, a, loop_names)
+                b = dict(uses)
+                self.scan(s.orelse, b, loop_names)
+                # a branch that leaves (return/raise/...) contributes no
+                # uses to the fallthrough path
+                merged = dict(uses)
+                if not _terminates(s.orelse):
+                    merged.update(b)
+                if not _terminates(s.body):
+                    merged.update(a)
+                uses.clear()
+                uses.update(merged)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    self._scan_calls(item.context_expr, uses, loop_names)
+                    if item.optional_vars is not None:
+                        self._bump(item.optional_vars)
+                self.scan(s.body, uses, loop_names)
+            elif isinstance(s, ast.Try):
+                self.scan(s.body, uses, loop_names)
+                for h in s.handlers:
+                    self.scan(h.body, uses, loop_names)
+                self.scan(s.orelse, uses, loop_names)
+                self.scan(s.finalbody, uses, loop_names)
+            else:
+                for child in ast.iter_child_nodes(s):
+                    self._scan_calls(child, uses, loop_names)
+
+
+class PrngReuseRule:
+    rule_id = RULE_ID
+    title = "PRNG key reuse"
+    hint = HINT
+
+    def check(self, mod) -> Iterator:
+        tree = mod.tree
+        bodies = [tree.body]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bodies.append(node.body)
+        findings: dict = {}
+        for body in bodies:
+            sc = _FnScan()
+            sc.scan(body, {}, None)
+            for line, message in sc.findings.items():
+                findings.setdefault(line, message)
+        for line in sorted(findings):
+            yield (self.rule_id, line, findings[line], self.hint)

@@ -1,0 +1,152 @@
+"""J03 -- recompile hazards around ``jax.jit``.
+
+Three shapes:
+
+* ``jax.jit(...)`` called inside a ``for``/``while`` body -- a fresh
+  compiled program (and cache entry) per iteration; hoist or cache it.
+* A Python ``if``/``while`` on a traced (non-static) parameter inside a
+  jitted function -- either a retrace per value or a concretisation
+  error; use ``lax.cond`` / ``jnp.where`` or mark the argument static.
+  ``x is None`` checks and ``isinstance`` tests are exempt (they are
+  resolved at trace time against structure, not values).
+* A dict/list/set *literal* passed positionally to a known-jitted
+  callable -- container structure is part of the cache key, so ad-hoc
+  literals retrace on every new shape.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from fed_tgan_tpu.analysis.rules.base import dotted, jitted_functions
+
+RULE_ID = "J03"
+HINT = ("hoist jit() out of loops and cache by static config; branch on "
+        "traced values with lax.cond/jnp.where or mark the arg static "
+        "(static_argnames)")
+
+_JIT_CALL_RE = re.compile(r"(?:^|\.)(?:jit|pjit)$")
+
+
+def _scan_jit_in_loop(tree):
+    """(line, message) for jit() calls lexically inside loop bodies."""
+    out = []
+
+    def visit(node, in_loop):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                visit(child, False)
+            return
+        if isinstance(node, ast.Call) and in_loop:
+            d = dotted(node.func) or ""
+            if _JIT_CALL_RE.search(d):
+                out.append((node.lineno, "jit() inside a loop compiles a "
+                                         "fresh program every iteration"))
+        loop = in_loop or isinstance(node, (ast.For, ast.AsyncFor,
+                                            ast.While))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)) and \
+                    child in (getattr(node, "iter", None),
+                              getattr(node, "test", None)):
+                visit(child, in_loop)
+            else:
+                visit(child, loop)
+
+    for stmt in tree.body:
+        visit(stmt, False)
+    return out
+
+
+def _none_checked(test) -> set:
+    """Names only compared against None / isinstance-checked in ``test``."""
+    exempt = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops) and \
+                all(isinstance(c, ast.Constant) and c.value is None
+                    for c in node.comparators):
+            exempt |= {n.id for n in ast.walk(node.left)
+                       if isinstance(n, ast.Name)}
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            if d in ("isinstance", "hasattr", "len", "callable"):
+                for a in node.args:
+                    exempt |= {n.id for n in ast.walk(a)
+                               if isinstance(n, ast.Name)}
+    return exempt
+
+
+def _traced_branches(tree):
+    out = []
+    for jf in jitted_functions(tree):
+        if jf.opaque_statics:
+            continue
+        body = jf.node.body
+        stmts = body if isinstance(body, list) else []
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                elif isinstance(node, ast.IfExp):
+                    test = node.test
+                else:
+                    continue
+                names = {n.id for n in ast.walk(test)
+                         if isinstance(n, ast.Name)}
+                hot = (names & jf.dynamic_params) - _none_checked(test)
+                if hot:
+                    out.append(
+                        (node.lineno,
+                         f"Python branch on traced argument(s) "
+                         f"{sorted(hot)} retraces per value (or fails "
+                         "to trace)"))
+    return out
+
+
+def _literal_args_to_jitted(tree):
+    """Calls of names bound to jax.jit(...) with container literals."""
+    jitted_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            d = dotted(node.value.func) or ""
+            if _JIT_CALL_RE.search(d):
+                jitted_names.add(node.targets[0].id)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id in jitted_names):
+            continue
+        for a in node.args:
+            if isinstance(a, (ast.Dict, ast.List, ast.Set)):
+                out.append(
+                    (a.lineno,
+                     "container literal passed to a jitted function "
+                     "retraces on every new structure; pass arrays or "
+                     "mark the argument static"))
+    return out
+
+
+class RecompileRule:
+    rule_id = RULE_ID
+    title = "recompile hazard"
+    hint = HINT
+
+    def check(self, mod) -> Iterator:
+        findings: dict = {}
+        for line, message in _scan_jit_in_loop(mod.tree):
+            findings.setdefault(line, message)
+        for line, message in _traced_branches(mod.tree):
+            findings.setdefault(line, message)
+        for line, message in _literal_args_to_jitted(mod.tree):
+            findings.setdefault(line, message)
+        for line in sorted(findings):
+            yield (self.rule_id, line, findings[line], self.hint)

@@ -1,0 +1,260 @@
+"""Runtime sanitizers: transfer guards, compile budgets, NaN debugging.
+
+Static lint (J01-J05) proves the *source* is clean; these prove the
+*process* is: with sanitizers enabled, designated hot regions run under
+``jax.transfer_guard_device_to_host("disallow")`` (an implicit pull
+raises instead of silently costing a round trip -- explicit
+``jax.device_get`` / ``copy_to_host_async`` stay legal, they ARE the
+sanctioned idiom), and every XLA compile event is counted per program
+so budget checks can assert "the fused epoch program compiled once" and
+"the serve engine compiled at most one program per bucket".
+
+Everything is opt-in and near-zero-cost when disabled:
+``hot_region(name)`` is a no-op unless :func:`enable_sanitizers` (or the
+``--sanitize`` CLI flag / ``sanitize()`` context manager) is active.
+The first entry of each named region runs unguarded -- tracing and
+compilation legitimately move constants -- the steady state is guarded
+from the second entry on.
+
+JAX is imported lazily so the lint prong never pays for it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import re
+import threading
+from typing import Dict, List, Optional
+
+__all__ = [
+    "CompileCounter",
+    "compile_report",
+    "check_compile_budgets",
+    "check_serving_budget",
+    "check_training_budget",
+    "disable_sanitizers",
+    "enable_sanitizers",
+    "hot_region",
+    "sanitize",
+    "sanitizing",
+]
+
+#: one record per trace+compile event, fired even on persistent-cache
+#: hits (the in-process trace still happens), once per distinct
+#: argument signature -- exactly the "did this retrace?" signal.
+_COMPILE_RE = re.compile(r"Compiling ([\w.<>\[\]-]+) with global shapes")
+_COMPILE_LOGGER = "jax._src.interpreters.pxla"
+
+#: tiny auxiliary programs jit emits around dispatch (weak-type casts,
+#: fill values); never interesting for budget accounting.
+_NOISE = {"convert_element_type", "broadcast_in_dim", "_multi_slice",
+          "multiply", "add", "true_divide", "fill", "copy", "iota",
+          "_threefry_split", "_threefry_fold_in", "ravel", "concatenate"}
+
+
+class CompileCounter(logging.Handler):
+    """Counts XLA trace/compile events per program name while attached."""
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.events: List[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILE_RE.search(record.getMessage())
+        except Exception:  # never let logging break the run
+            return
+        if m:
+            # logging.Handler.handle() already serialises emit() calls
+            # under the handler's own lock
+            self.events.append(m.group(1))  # jaxlint: disable=J05
+
+    # ----------------------------------------------------------- queries
+
+    def counts(self, include_noise: bool = False) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for name in self.events:
+            if include_noise or name not in _NOISE:
+                out[name] = out.get(name, 0) + 1
+        return out
+
+    def count(self, name_substring: str) -> int:
+        return sum(n for name, n in self.counts(include_noise=True).items()
+                   if name_substring in name)
+
+    def reset(self) -> None:
+        self.events = []
+
+
+class _State:
+    def __init__(self) -> None:
+        self.active = False
+        self.counter: Optional[CompileCounter] = None
+        self.warmups: Dict[str, int] = {}
+        self.guard_warmup = False  # guard even first entries (strict)
+        self._saved: dict = {}
+        self._lock = threading.Lock()
+
+
+_STATE = _State()
+
+
+def sanitizing() -> bool:
+    return _STATE.active
+
+
+def enable_sanitizers(transfer_guard: bool = True,
+                      compile_counter: bool = True,
+                      nan_debug: bool = False,
+                      guard_warmup: bool = False) -> Optional[CompileCounter]:
+    """Turn the sanitizers on process-wide.  Returns the compile counter
+    (None when ``compile_counter`` is off).  Idempotent; pair with
+    :func:`disable_sanitizers` or use the :func:`sanitize` context."""
+    import jax
+
+    st = _STATE
+    with st._lock:
+        if st.active:
+            return st.counter
+        st._saved = {
+            "log_compiles": jax.config.jax_log_compiles,
+            "debug_nans": jax.config.jax_debug_nans,
+        }
+        st.warmups = {}
+        st.guard_warmup = guard_warmup
+        st.active = True
+        st.counter = None
+        if not transfer_guard:
+            # transfer_guard=False: regions still tracked, never guarded
+            st.guard_warmup = False
+            st.warmups = None  # type: ignore[assignment]
+        if compile_counter:
+            jax.config.update("jax_log_compiles", True)
+            logger = logging.getLogger(_COMPILE_LOGGER)
+            st._saved["logger_level"] = logger.level
+            if logger.level > logging.WARNING or logger.level == 0:
+                logger.setLevel(logging.WARNING)
+            st.counter = CompileCounter()
+            logger.addHandler(st.counter)
+        if nan_debug:
+            jax.config.update("jax_debug_nans", True)
+        return st.counter
+
+
+def disable_sanitizers() -> None:
+    import jax
+
+    st = _STATE
+    with st._lock:
+        if not st.active:
+            return
+        if st.counter is not None:
+            logger = logging.getLogger(_COMPILE_LOGGER)
+            logger.removeHandler(st.counter)
+            if "logger_level" in st._saved:
+                logger.setLevel(st._saved["logger_level"])
+        jax.config.update("jax_log_compiles", st._saved["log_compiles"])
+        jax.config.update("jax_debug_nans", st._saved["debug_nans"])
+        st.active = False
+        st.counter = None
+        st.warmups = {}
+
+
+@contextlib.contextmanager
+def sanitize(transfer_guard: bool = True, compile_counter: bool = True,
+             nan_debug: bool = False, guard_warmup: bool = False):
+    """``with sanitize() as counter:`` -- scoped enable/disable."""
+    counter = enable_sanitizers(transfer_guard=transfer_guard,
+                                compile_counter=compile_counter,
+                                nan_debug=nan_debug,
+                                guard_warmup=guard_warmup)
+    try:
+        yield counter
+    finally:
+        disable_sanitizers()
+
+
+@contextlib.contextmanager
+def hot_region(name: str, guard: str = "disallow"):
+    """Mark a steady-state device-dispatch region.
+
+    No-op unless sanitizers are active.  The first entry per ``name``
+    runs unguarded (tracing/compilation legitimately transfers
+    constants); later entries run under
+    ``jax.transfer_guard_device_to_host(guard)`` so any *implicit*
+    device->host pull raises.  Explicit ``jax.device_get`` and
+    ``copy_to_host_async`` remain allowed -- they are the fix idiom J01
+    points at, not the bug."""
+    st = _STATE
+    if not st.active or st.warmups is None:
+        yield
+        return
+    n = st.warmups.get(name, 0)
+    st.warmups[name] = n + 1
+    if n == 0 and not st.guard_warmup:
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_device_to_host(guard):
+        yield
+
+
+# ----------------------------------------------------------------- budgets
+
+def check_compile_budgets(budgets: Dict[str, int],
+                          counter: Optional[CompileCounter] = None
+                          ) -> List[str]:
+    """Violations for ``{program-name-substring: max_compiles}``."""
+    counter = counter or _STATE.counter
+    if counter is None:
+        return []
+    out = []
+    for name, budget in budgets.items():
+        n = counter.count(name)
+        if n > budget:
+            out.append(f"program '{name}' compiled {n}x "
+                       f"(budget {budget}) -- retrace leak?")
+    return out
+
+
+def check_training_budget(trainer, counter=None) -> List[str]:
+    """The fused epoch program must compile once per distinct
+    (chunk-size, fault-window) variant -- ``trainer._epoch_fns`` holds
+    exactly that set.  (A watchdog rollback that rebuilds the trainer
+    legitimately recompiles; check against the final trainer.)"""
+    fns = getattr(trainer, "_epoch_fns", None)
+    if fns is None:
+        return []
+    return check_compile_budgets({"epoch_local": max(1, len(fns))}, counter)
+
+
+def check_serving_budget(engine, counter=None) -> List[str]:
+    """The serve engine compiles at most one program per
+    (power-of-two bucket, conditional?) pair -- and each bucket's
+    program exactly once."""
+    counter = counter or _STATE.counter
+    programs = getattr(engine, "_programs", None)
+    if counter is None or programs is None:
+        return []
+    out = check_compile_budgets(
+        {"serve_bucket_": max(1, len(programs))}, counter)
+    for name, n in counter.counts(include_noise=True).items():
+        if name.startswith("serve_bucket_") and n > 1:
+            out.append(f"bucket program '{name}' compiled {n}x "
+                       "(budget 1) -- bucket cache miss?")
+    return out
+
+
+def compile_report(counter: Optional[CompileCounter] = None) -> str:
+    counter = counter or _STATE.counter
+    if counter is None:
+        return "sanitize: compile counter inactive"
+    counts = counter.counts()
+    if not counts:
+        return "sanitize: 0 compile events"
+    lines = [f"sanitize: {sum(counts.values())} compile event(s):"]
+    for name in sorted(counts, key=counts.get, reverse=True):
+        lines.append(f"  {counts[name]:4d}x {name}")
+    return "\n".join(lines)

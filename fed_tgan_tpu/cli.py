@@ -224,6 +224,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-rounds", type=int, default=3,
                    help="rounds inside the --profile-dir trace (steady-state "
                         "tail of the run; default 3)")
+    p.add_argument("--sanitize", action="store_true",
+                   help="runtime sanitizers: transfer guards around hot "
+                        "regions + per-program compile budgets (exit 4 on "
+                        "a budget violation); see fed_tgan_tpu.analysis")
+    p.add_argument("--sanitize-nans", action="store_true",
+                   help="with --sanitize semantics plus jax_debug_nans: "
+                        "raise at the op that produced the first NaN")
     p.add_argument("--quiet", action="store_true")
     # reference-compatible world bookkeeping (ignored in SPMD mode)
     p.add_argument("-rank", "--rank", type=int, default=None)
@@ -608,6 +615,11 @@ def main(argv=None) -> int:
     rc = _select_backend(args)
     if rc:
         return rc
+
+    if args.sanitize or args.sanitize_nans:
+        from fed_tgan_tpu.analysis.sanitizers import enable_sanitizers
+
+        enable_sanitizers(nan_debug=args.sanitize_nans)
 
     import numpy as np
     import pandas as pd
@@ -1029,6 +1041,17 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
         n = max(len(trainer.epoch_times), 1)
         print(f"{len(trainer.epoch_times)} rounds in {total:.1f}s "
               f"({total / n:.3f}s/round)")
+
+    from fed_tgan_tpu.analysis import sanitizers
+
+    if sanitizers.sanitizing():
+        if not args.quiet:
+            print(sanitizers.compile_report())
+        problems = sanitizers.check_training_budget(trainer)
+        for problem in problems:
+            print(f"SANITIZE: {problem}")
+        if problems:
+            return 4
     return 0
 
 

@@ -167,6 +167,32 @@ def check_compile_cache() -> bool:
                  f"{sub} ({n} entries, machine fingerprint {fp})")
 
 
+def check_static_analysis() -> bool:
+    """The jaxlint gate: AST rules J01-J05 over the package, diffed
+    against the checked-in baseline.  Pure stdlib -- no JAX tracing."""
+    try:
+        from fed_tgan_tpu.analysis.lint import (
+            apply_baseline,
+            load_baseline,
+            run_lint,
+        )
+
+        findings = run_lint()
+        new, old, stale = apply_baseline(findings, load_baseline())
+    except Exception as exc:
+        return _line(False, "static-analysis", f"{exc!r}")
+    if new:
+        heads = ", ".join(f.key for f in new[:3])
+        more = f" (+{len(new) - 3} more)" if len(new) > 3 else ""
+        return _line(False, "static-analysis",
+                     f"{len(new)} non-baselined finding(s): {heads}{more} "
+                     "-- run python -m fed_tgan_tpu.analysis")
+    return _line(True, "static-analysis",
+                 f"jaxlint clean: {len(findings)} finding(s) all baselined"
+                 f" ({len(stale)} stale baseline entr"
+                 f"{'y' if len(stale) == 1 else 'ies'}, rules J01-J05)")
+
+
 def check_robust_aggregation() -> bool:
     """Each robust aggregator rejects a poisoned client on a tiny pytree.
 
@@ -391,6 +417,7 @@ def main(argv=None) -> int:
         check_transport(),
         check_robust_aggregation(),
         check_compile_cache(),
+        check_static_analysis(),
         check_serving(),
     ]
     bad = checks.count(False)

@@ -21,10 +21,12 @@ constant one-hot; the condition position is a traced scalar, so every
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
 
+from fed_tgan_tpu.analysis.sanitizers import hot_region
 from fed_tgan_tpu.serve.registry import LoadedModel
 
 
@@ -45,6 +47,10 @@ class SamplingEngine:
     def __init__(self, model: LoadedModel, max_chunk_steps: int = 128):
         self.max_chunk_steps = max_chunk_steps
         self._programs: dict = {}
+        # HTTP handler threads read (resolve_condition, self.model) while
+        # the batch worker swaps models / fills the program cache — the
+        # lock makes adoption atomic w.r.t. readers (jaxlint J05)
+        self._lock = threading.RLock()
         self._adopt_fields(model)
 
     def _adopt_fields(self, model: LoadedModel) -> None:
@@ -61,17 +67,18 @@ class SamplingEngine:
         compiled programs are kept — new params are just new arguments —
         and adoption is free; otherwise the program cache is rebuilt.
         Returns whether the programs were kept."""
-        same_shape = (
-            model.synth.transformer.output_info
-            == self.model.synth.transformer.output_info
-            and model.synth.cfg == self.cfg
-            and self._decode_plan_signature(model)
-            == self._decode_plan_signature(self.model)
-        )
-        if not same_shape:
-            self._programs = {}
-        self._adopt_fields(model)
-        return same_shape
+        with self._lock:
+            same_shape = (
+                model.synth.transformer.output_info
+                == self.model.synth.transformer.output_info
+                and model.synth.cfg == self.cfg
+                and self._decode_plan_signature(model)
+                == self._decode_plan_signature(self.model)
+            )
+            if not same_shape:
+                self._programs = {}
+            self._adopt_fields(model)
+            return same_shape
 
     @staticmethod
     def _decode_plan_signature(model: LoadedModel) -> tuple:
@@ -93,6 +100,11 @@ class SamplingEngine:
 
     def _program(self, n_steps: int, conditional: bool):
         key = (n_steps, conditional)
+        with self._lock:
+            return self._program_fill(key, n_steps, conditional)
+
+    def _program_fill(self, key, n_steps: int, conditional: bool):
+        # only ever called with self._lock held (see _program/adopt)
         if key not in self._programs:
             import jax
             import jax.numpy as jnp
@@ -129,7 +141,13 @@ class SamplingEngine:
                 _, out = jax.lax.scan(body, None, jnp.arange(n_steps))
                 return decode_fn(out.reshape(n_steps * B, -1))
 
-            self._programs[key] = jax.jit(run)
+            # distinct compiled-program name per bucket, so the sanitizer
+            # compile counter can assert "<= one compile per bucket"
+            run.__name__ = (f"serve_bucket_{n_steps}"
+                            f"{'_cond' if conditional else ''}")
+            run.__qualname__ = run.__name__
+            with self._lock:  # re-entrant: callers already hold it
+                self._programs[key] = jax.jit(run)
         return self._programs[key]
 
     def _chunk_plan(self, first_step: int, total_steps: int):
@@ -150,7 +168,15 @@ class SamplingEngine:
     # ------------------------------------------------------------ sampling
 
     def resolve_condition(self, column: str, value) -> int:
-        """(column name, raw category value) -> conditional-vector position."""
+        """(column name, raw category value) -> conditional-vector position.
+
+        Called from HTTP handler threads; holds the engine lock so the
+        (meta, columns, encoders) triple is read from ONE model, never a
+        half-adopted mix."""
+        with self._lock:
+            return self._resolve_condition_locked(column, value)
+
+    def _resolve_condition_locked(self, column: str, value) -> int:
         from fed_tgan_tpu.features.transformer import DiscreteColumn
 
         meta = self.model.meta
@@ -222,9 +248,13 @@ class SamplingEngine:
         for start, steps in self._chunk_plan(first_step, total_steps):
             # double-buffered like SampleProgramCache.sample: chunk i+1
             # computes while chunk i transfers, at most 2 buffers live
-            chunk = self._program(steps, conditional)(
-                synth.params_g, synth.state_g, synth.cond, key, start, pos
-            )
+            prog = self._program(steps, conditional)
+            with hot_region(f"serve.engine[{steps}"
+                            f"{'c' if conditional else ''}]"):
+                chunk = prog(
+                    synth.params_g, synth.state_g, synth.cond, key, start,
+                    pos
+                )
             chunk.copy_to_host_async()
             pending.append(chunk)
             if len(pending) == 2:

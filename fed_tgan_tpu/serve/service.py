@@ -352,6 +352,10 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--allow-meta-mismatch", action="store_true",
                     help="serve even when the meta JSON postdates the "
                          "synthesizer (see --sample-from)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="runtime sanitizers: transfer guards on the "
+                         "steady-state sampling dispatch + a one-compile-"
+                         "per-bucket budget (exit 4 on violation)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -360,6 +364,10 @@ def serve_main(argv=None) -> int:
 
     # warm restarts skip the per-bucket XLA compiles entirely
     _enable_compile_cache()
+    if args.sanitize:
+        from fed_tgan_tpu.analysis.sanitizers import enable_sanitizers
+
+        enable_sanitizers()
     log = (lambda *a, **k: None) if args.quiet else print
     try:
         registry = ModelRegistry(args.artifact,
@@ -385,6 +393,15 @@ def serve_main(argv=None) -> int:
     except KeyboardInterrupt:
         print("serve: draining...", flush=True)
         service.shutdown(drain=True)
+    if args.sanitize:
+        from fed_tgan_tpu.analysis import sanitizers
+
+        print(sanitizers.compile_report())
+        problems = sanitizers.check_serving_budget(service.engine)
+        for problem in problems:
+            print(f"SANITIZE: {problem}")
+        if problems:
+            return 4
     return 0
 
 

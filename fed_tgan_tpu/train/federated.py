@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from fed_tgan_tpu.analysis.sanitizers import hot_region
 from fed_tgan_tpu.federation.init import FederatedInit, renormalize_weights
 from fed_tgan_tpu.ops.segments import SegmentSpec
 from fed_tgan_tpu.parallel.fedavg import (
@@ -667,18 +668,25 @@ class FederatedTrainer(RoundBookkeeping):
             # last-good, for a failed sync
             prev = (self.models, self._key, self.ema, self._ema_updates)
             t0 = time.time()
+            # steady-state dispatch is a sanitizer hot region: under
+            # --sanitize any implicit device->host pull in here raises
+            # (first entry per region compiles and stays unguarded)
+            region = f"train.federated.epoch[r{size}" \
+                     f"{'+fault' if update_fault else ''}]"
             if use_ema:
-                (models, metrics, self._key, finite,
-                 self.ema) = self._epoch_fn_for(size, update_fault)(
-                    models, data, cond, rows, steps, weights, self._key,
-                    self.ema,
-                )
+                with hot_region(region):
+                    (models, metrics, self._key, finite,
+                     self.ema) = self._epoch_fn_for(size, update_fault)(
+                        models, data, cond, rows, steps, weights, self._key,
+                        self.ema,
+                    )
                 self._ema_updates += size
             else:
-                (models, metrics, self._key,
-                 finite) = self._epoch_fn_for(size, update_fault)(
-                    models, data, cond, rows, steps, weights, self._key
-                )
+                with hot_region(region):
+                    (models, metrics, self._key,
+                     finite) = self._epoch_fn_for(size, update_fault)(
+                        models, data, cond, rows, steps, weights, self._key
+                    )
             # divergence check: ONE scalar crosses to host (fetching it also
             # serves as the chunk's sync point); the full metric arrays are
             # pulled only on the failure path to name the bad round.  State
@@ -717,10 +725,24 @@ class FederatedTrainer(RoundBookkeeping):
             # measured wall-neutral on the tunneled chip (PARITY.md)
             self._sync_or_rollback(finite, _rollback, sample_hook)
             ok = on_nonfinite == "ignore" or bool(finite)
+            # every consumer of metric VALUES below (divergence naming,
+            # quarantine counts, health watchdog, log means) reads this
+            # ONE explicit batched transfer — a single host round trip
+            # per chunk instead of one per np.asarray (jaxlint J01)
+            log_due = bool(log_every) and any(
+                ei % log_every == 0 for ei in range(e, e + size))
+            need_host = (
+                not ok
+                or health_cb is not None
+                or log_due
+                or (isinstance(metrics, dict) and "quarantined" in metrics)
+            )
+            metrics_host = jax.device_get(metrics) if need_host else None
             if not ok:
-                self._check_finite(metrics, e, on_nonfinite)
-            if isinstance(metrics, dict) and "quarantined" in metrics:
-                q = np.asarray(metrics["quarantined"]) > 0.5  # (size, n)
+                self._check_finite(metrics_host, e, on_nonfinite)
+            if isinstance(metrics_host, dict) and \
+                    "quarantined" in metrics_host:
+                q = np.asarray(metrics_host["quarantined"]) > 0.5  # (size, n)
                 if q.any():
                     counts = q.sum(axis=0).astype(np.int64)
                     self._strikes += counts
@@ -748,7 +770,7 @@ class FederatedTrainer(RoundBookkeeping):
                     data, cond, rows, steps, weights = self._device_stacks
             if health_cb is not None:
                 health_cb(e, {name: np.asarray(v)
-                              for name, v in metrics.items()})
+                              for name, v in metrics_host.items()})
             per_round = (time.time() - t0 - t_pre) / size
             for ei in range(e, e + size):
                 self._finish_round(
@@ -756,8 +778,9 @@ class FederatedTrainer(RoundBookkeeping):
                     sample_hook if (ei == last and ei in firing) else None,
                     pre_hook_s=t_pre if ei == last else 0.0,
                 )
-            if log_every and any(ei % log_every == 0 for ei in range(e, e + size)):
-                m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
+            if log_due:
+                m = jax.tree.map(lambda x: np.asarray(x).mean(),
+                                 metrics_host)
                 print(
                     f"round {last}: loss_d={m['loss_d']:.3f} pen={m['pen']:.3f} "
                     f"loss_g={m['loss_g']:.3f} ({self.epoch_times[-1]:.3f}s/round)"

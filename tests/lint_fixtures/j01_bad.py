@@ -1,0 +1,43 @@
+"""J01 bad twin: host syncs on jitted outputs inside hot loops.
+
+Never imported -- parsed by the linter only.  ``# EXPECT: JXX`` marks
+the exact (rule, line) pairs the tests assert.
+"""
+import jax
+import numpy as np
+
+
+def fit_loop(step_fn, steps):
+    program = jax.jit(step_fn)
+    out = []
+    for s in range(steps):
+        metrics = program(s)
+        out.append(np.asarray(metrics["loss"]))  # EXPECT: J01
+        print(float(metrics["loss"]))  # EXPECT: J01
+        if metrics["loss"].item() > 0:  # EXPECT: J01
+            break
+    return out
+
+
+def tree_pull(step_fn, steps):
+    m = None
+    for s in range(steps):
+        metrics = step_fn.epoch_fn(s)
+        m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)  # EXPECT: J01
+    return m
+
+
+def helper_called_from_loop(metrics):
+    return np.asarray(metrics["loss"])  # EXPECT: J01
+
+
+def driver(step_fn, steps):
+    program = jax.jit(step_fn)
+    for s in range(steps):
+        metrics = program(s)
+        helper_called_from_loop(metrics)
+
+
+def comprehension_pull(step_fn, xs):
+    program = jax.jit(step_fn)
+    return [float(program(x)) for x in xs]  # EXPECT: J01

@@ -1,0 +1,46 @@
+"""J01 good twin: the same shapes done right -- zero findings.
+
+One explicit batched ``jax.device_get`` per iteration is the sanctioned
+idiom; one-shot pulls outside any loop are not hot-path syncs at all.
+"""
+import jax
+import numpy as np
+
+
+def fit_loop(step_fn, steps):
+    program = jax.jit(step_fn)
+    out = []
+    for s in range(steps):
+        metrics = program(s)
+        host = jax.device_get(metrics)  # ONE explicit transfer
+        out.append(host["loss"])
+        print(float(host["loss"]))
+        if host["loss"] > 0:
+            break
+    return float(np.mean(out))
+
+
+def tree_pull(step_fn, steps):
+    m = None
+    for s in range(steps):
+        metrics = step_fn.epoch_fn(s)
+        host = jax.device_get(metrics)
+        m = jax.tree.map(lambda x: np.asarray(x).mean(), host)
+    return m
+
+
+def helper_on_host(metrics_host):
+    return np.asarray(metrics_host["loss"])
+
+
+def driver(step_fn, steps):
+    program = jax.jit(step_fn)
+    for s in range(steps):
+        metrics = program(s)
+        helper_on_host(jax.device_get(metrics))
+
+
+def one_shot(step_fn):
+    program = jax.jit(step_fn)
+    metrics = program(0)
+    return np.asarray(metrics["loss"])  # not in a loop: a single pull

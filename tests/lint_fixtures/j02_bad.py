@@ -1,0 +1,29 @@
+"""J02 bad twin: the same PRNG key consumed twice."""
+import jax
+
+
+def double_use(key, shape):
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # EXPECT: J02
+    return a + b
+
+
+def loop_reuse(key, n):
+    out = 0.0
+    for _ in range(n):
+        out += jax.random.normal(key, ())  # EXPECT: J02
+    return out
+
+
+def split_then_reuse(key):
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, ())
+    y = jax.random.normal(k1, ())  # EXPECT: J02
+    return x + y + jax.random.normal(k2, ())
+
+
+def indexed_reuse(key):
+    ks = jax.random.split(key, 3)
+    a = jax.random.normal(ks[0], ())
+    b = jax.random.uniform(ks[0], ())  # EXPECT: J02
+    return a + b

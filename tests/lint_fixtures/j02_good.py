@@ -1,0 +1,38 @@
+"""J02 good twin: key discipline done right -- zero findings."""
+import jax
+
+
+def independent(key, shape):
+    ka, kb = jax.random.split(key)
+    return jax.random.normal(ka, shape) + jax.random.uniform(kb, shape)
+
+
+def loop_fold(key, n):
+    out = 0.0
+    for i in range(n):
+        out += jax.random.normal(jax.random.fold_in(key, i), ())
+    return out
+
+
+def fresh_each_iter(key, n):
+    use = None
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        use = jax.random.normal(sub, ())
+    return use
+
+
+def branch_either(key, flag):
+    if flag:
+        return jax.random.normal(key, ())
+    return jax.random.uniform(key, ())
+
+
+def indexed(key):
+    ks = jax.random.split(key, 3)
+    return jax.random.normal(ks[0], ()) + jax.random.uniform(ks[1], ())
+
+
+def dynamic_index(key, n):
+    ks = jax.random.split(key, n)
+    return [jax.random.normal(ks[i], ()) for i in range(n)]

@@ -1,0 +1,26 @@
+"""J03 bad twin: recompile hazards -- jit-in-loop, traced branches,
+unhashable literal args to jitted callables."""
+import jax
+
+
+def step(x, lr):
+    return x - lr * x
+
+
+def rejit_in_loop(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(step)(x, 0.1))  # EXPECT: J03
+    return out
+
+
+@jax.jit
+def branch_on_traced(x, flag):
+    if flag:  # EXPECT: J03
+        return x * 2.0
+    return x
+
+
+def dict_arg(x):
+    g = jax.jit(step)
+    return g(x, {"lr": 0.1})  # EXPECT: J03

@@ -1,0 +1,34 @@
+"""J03 good twin: hoisted jit, static branching, hashable statics --
+zero findings."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def step(x, lr):
+    return x - lr * x
+
+
+def hoisted(xs):
+    program = jax.jit(step)  # compiled once, reused per iteration
+    return [program(x, 0.1) for x in xs]
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def static_branch(x, flag):
+    if flag is None:
+        return x
+    if flag:
+        return x * 2.0
+    return x
+
+
+@jax.jit
+def data_branch(x, flag):
+    return jnp.where(flag, x * 2.0, x)
+
+
+def scalar_args(x):
+    g = jax.jit(step)
+    return g(x, 0.1)

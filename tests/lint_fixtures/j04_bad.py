@@ -1,0 +1,17 @@
+"""J04 bad twin: host numpy applied to traced values inside jit."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated(x):
+    return np.mean(x)  # EXPECT: J04
+
+
+def body(x):
+    y = np.clip(x, 0.0, 1.0)  # EXPECT: J04
+    return y * 2.0
+
+
+def build():
+    return jax.jit(body)

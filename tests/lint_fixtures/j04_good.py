@@ -1,0 +1,21 @@
+"""J04 good twin: jnp on traced values; numpy only on static
+constants -- zero findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TABLE = np.arange(8.0)  # module-level host constant: fine
+
+
+@jax.jit
+def decorated(x):
+    return jnp.mean(x)
+
+
+def body(x):
+    base = jnp.asarray(np.arange(8.0))  # constant, not traced
+    return jnp.clip(x, 0.0, 1.0) + base.sum()
+
+
+def build():
+    return jax.jit(body)

@@ -1,0 +1,26 @@
+"""J05 bad twin: shared mutable state touched off-lock in a threaded
+module."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.cache = {}
+
+    def hit(self, key, value):
+        self.requests += 1  # EXPECT: J05
+        self.cache[key] = value  # EXPECT: J05
+
+    def read(self, key):
+        with self._lock:
+            return self.cache.get(key)
+
+
+class NoLockQueue:
+    def __init__(self):
+        self.items = []
+
+    def put(self, item):
+        self.items.append(item)  # EXPECT: J05

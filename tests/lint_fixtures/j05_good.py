@@ -1,0 +1,28 @@
+"""J05 good twin: every shared mutation lock-held or on an
+intrinsically thread-safe container -- zero findings."""
+import queue
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.cache = {}
+
+    def hit(self, key, value):
+        with self._lock:
+            self.requests += 1
+            self.cache[key] = value
+
+    def read(self, key):
+        with self._lock:
+            return self.cache.get(key)
+
+
+class SafeQueue:
+    def __init__(self):
+        self.items = queue.Queue()  # Queue serialises internally
+
+    def put(self, item):
+        self.items.put(item)

@@ -1,0 +1,138 @@
+"""jaxlint: rule unit tests over good/bad fixture twins, baseline
+ratchet, suppression syntax, CLI exit codes, and the repo-wide gate."""
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from fed_tgan_tpu.analysis.__main__ import main as lint_main
+from fed_tgan_tpu.analysis.lint import (
+    DEFAULT_BASELINE_PATH,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+from fed_tgan_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+_EXPECT_RE = re.compile(r"# EXPECT: (J\d\d)")
+
+
+def _expected(path: Path):
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), 1):
+        m = _EXPECT_RE.search(line)
+        if m:
+            out.add((m.group(1), i))
+    return out
+
+
+@pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j05"])
+def test_bad_twin_exact_findings(rule_id):
+    path = FIXTURES / f"{rule_id}_bad.py"
+    expected = _expected(path)
+    assert expected, f"{path.name} carries no EXPECT markers"
+    got = {(f.rule, f.line) for f in run_lint(paths=[path])}
+    assert got == expected
+
+
+@pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j05"])
+def test_good_twin_zero_findings(rule_id):
+    path = FIXTURES / f"{rule_id}_good.py"
+    findings = run_lint(paths=[path])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_findings_carry_hint_and_key():
+    f = run_lint(paths=[FIXTURES / "j01_bad.py"])[0]
+    assert f.rule == "J01"
+    assert f.hint
+    assert f.key == f"{f.path}:{f.rule}:{f.line}"
+    assert f"{f.path}:{f.line}" in f.render()
+
+
+def test_inline_suppression(tmp_path):
+    src = FIXTURES / "j02_bad.py"
+    text = src.read_text().replace(
+        "# EXPECT: J02", "# jaxlint: disable=J02")
+    sup = tmp_path / "suppressed.py"
+    sup.write_text(text)
+    assert run_lint(paths=[sup]) == []
+    # a disable for a *different* rule must not silence J02
+    wrong = tmp_path / "wrong_rule.py"
+    wrong.write_text(src.read_text().replace(
+        "# EXPECT: J02", "# jaxlint: disable=J01"))
+    assert len(run_lint(paths=[wrong])) == len(_expected(src))
+
+
+def test_bare_disable_silences_all(tmp_path):
+    text = (FIXTURES / "j05_bad.py").read_text().replace(
+        "# EXPECT: J05", "# jaxlint: disable")
+    p = tmp_path / "bare.py"
+    p.write_text(text)
+    assert run_lint(paths=[p]) == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = run_lint(paths=[FIXTURES / "j03_bad.py"])
+    bl = tmp_path / "baseline.json"
+    save_baseline(findings, bl)
+    loaded = load_baseline(bl)
+    new, old, stale = apply_baseline(findings, loaded)
+    assert new == [] and len(old) == len(findings) and stale == set()
+    # a finding missing from the baseline is new; an entry with no
+    # matching finding is stale
+    partial = set(sorted(loaded)[:-1])
+    new, _old, stale = apply_baseline(findings, partial)
+    assert len(new) == 1 and stale == set()
+    _new, _old, stale = apply_baseline(findings, loaded | {"gone:J01:1"})
+    assert stale == {"gone:J01:1"}
+    data = json.loads(bl.read_text())
+    assert data["version"] == 1 and len(data["findings"]) == len(findings)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = str(FIXTURES / "j04_bad.py")
+    good = str(FIXTURES / "j04_good.py")
+    assert lint_main([good, "--no-baseline"]) == 0
+    assert lint_main([bad, "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "J04" in out and "j04_bad.py" in out
+    bl = tmp_path / "bl.json"
+    assert lint_main([bad, "--baseline", str(bl),
+                      "--baseline-update"]) == 0
+    assert lint_main([bad, "--baseline", str(bl)]) == 0  # now ratcheted
+    assert lint_main([str(tmp_path / "missing_dir_zzz")]) == 2
+
+
+def test_cli_json_format(capsys):
+    assert lint_main([str(FIXTURES / "j02_bad.py"), "--no-baseline",
+                      "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["new"] and all(":J02:" in k for k in payload["new"])
+    assert {f["rule"] for f in payload["findings"]} == {"J02"}
+
+
+def test_cli_rule_filter():
+    bad = str(FIXTURES / "j01_bad.py")
+    assert lint_main([bad, "--no-baseline", "--rules", "J02"]) == 0
+    assert lint_main([bad, "--no-baseline", "--rules", "J01,J02"]) == 1
+
+
+def test_rule_registry_complete():
+    assert {r.rule_id for r in ALL_RULES} == {
+        "J01", "J02", "J03", "J04", "J05"}
+    for rid, rule in RULES_BY_ID.items():
+        assert rule.rule_id == rid and rule.hint and rule.title
+
+
+def test_repo_lint_gate():
+    """Tier-1 gate: the package linted against the shipped baseline
+    must produce zero new findings (the CI ratchet)."""
+    findings = run_lint()
+    baseline = load_baseline(DEFAULT_BASELINE_PATH)
+    new, _old, _stale = apply_baseline(findings, baseline)
+    assert new == [], "new jaxlint findings:\n" + "\n".join(
+        f.render() for f in new)

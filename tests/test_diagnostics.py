@@ -1,3 +1,5 @@
+import re
+
 import jax
 import numpy as np
 
@@ -75,10 +77,12 @@ def test_doctor_cli_all_green_on_cpu(tmp_path):
         cwd="/root/repo",
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
-    assert "7/7 checks passed" in proc.stdout
+    m = re.search(r"(\d+)/(\d+) checks passed", proc.stdout)
+    assert m and m.group(1) == m.group(2), proc.stdout
     assert "FAIL" not in proc.stdout
     for name in ("runtime", "backend", "virtual-mesh", "transport",
-                 "robust-agg", "compile-cache", "serving"):
+                 "robust-agg", "compile-cache", "static-analysis",
+                 "serving"):
         assert f"OK   {name}" in proc.stdout, proc.stdout
 
 

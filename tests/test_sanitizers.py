@@ -1,0 +1,173 @@
+"""Runtime sanitizers: compile counter, hot-region transfer guards,
+budget checks -- plus the satellite regressions: training is
+bit-identical with sanitizers on, and the serve engine stays within
+one compile per bucket."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.analysis import sanitizers
+from fed_tgan_tpu.analysis.sanitizers import (
+    check_compile_budgets,
+    check_serving_budget,
+    check_training_budget,
+    compile_report,
+    hot_region,
+    sanitize,
+    sanitizing,
+)
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.parallel.mesh import client_mesh
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+
+CFG = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                  batch_size=40, pac=4)
+
+
+@pytest.fixture(autouse=True)
+def _sanitizers_off():
+    yield
+    sanitizers.disable_sanitizers()
+
+
+# ------------------------------------------------------------- unit tests
+
+def test_compile_counter_counts_distinct_signatures():
+    def poly2(x):
+        return x * x + 2.0 * x
+
+    with sanitize() as counter:
+        prog = jax.jit(poly2)
+        prog(jnp.ones((3,))).block_until_ready()
+        assert counter.count("poly2") == 1
+        prog(jnp.ones((3,))).block_until_ready()  # cache hit: no retrace
+        assert counter.count("poly2") == 1
+        prog(jnp.ones((5,))).block_until_ready()  # new shape: retrace
+        assert counter.count("poly2") == 2
+        assert counter.counts().get("poly2") == 2
+        counter.reset()
+        assert counter.count("poly2") == 0
+
+
+def test_hot_region_guards_from_second_entry():
+    def guard():
+        return jax.config.jax_transfer_guard_device_to_host
+
+    with hot_region("inactive"):
+        assert guard() is None  # no-op: sanitizers off
+    with sanitize(compile_counter=False):
+        assert sanitizing()
+        with hot_region("region-a"):
+            assert guard() is None  # warmup entry: tracing may transfer
+        with hot_region("region-a"):
+            assert guard() == "disallow"
+        with hot_region("region-b"):
+            assert guard() is None  # independent warmup per name
+    assert not sanitizing()
+    assert guard() is None
+
+
+def test_hot_region_strict_warmup():
+    with sanitize(compile_counter=False, guard_warmup=True):
+        with hot_region("strict"):
+            assert jax.config.jax_transfer_guard_device_to_host \
+                == "disallow"
+
+
+def test_sanitize_restores_jax_config():
+    before = jax.config.jax_log_compiles
+    with sanitize():
+        assert jax.config.jax_log_compiles
+    assert jax.config.jax_log_compiles == before
+
+
+def test_nan_debug_raises():
+    with sanitize(nan_debug=True):
+        with pytest.raises(FloatingPointError):
+            jax.jit(jnp.log)(jnp.float32(-1.0)).block_until_ready()
+
+
+def test_compile_budget_violation_message():
+    def churn(x):
+        return x + 1.0
+
+    with sanitize() as counter:
+        for n in (2, 3, 4):  # one retrace per shape: a retrace leak
+            jax.jit(churn)(jnp.ones((n,))).block_until_ready()
+        problems = check_compile_budgets({"churn": 1}, counter)
+        assert len(problems) == 1 and "3x" in problems[0]
+        assert check_compile_budgets({"churn": 3}, counter) == []
+        assert "churn" in compile_report(counter)
+
+
+def test_budget_checks_inert_without_counter():
+    assert check_compile_budgets({"anything": 0}) == []
+    assert check_training_budget(object()) == []
+    assert check_serving_budget(object()) == []
+
+
+# -------------------------------------------------- training (satellite)
+
+@pytest.fixture(scope="module")
+def fed_init(toy_frame, toy_spec):
+    shards = shard_dataframe(toy_frame, 4, "iid", seed=9)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    return federated_initialize(clients, seed=0)
+
+
+def _fit_params(fed_init, epochs=2):
+    tr = FederatedTrainer(fed_init, config=CFG, mesh=client_mesh(4), seed=0)
+    tr.fit(epochs=epochs)
+    return tr, np.asarray(jax.tree.leaves(tr.models.params_g)[0])
+
+
+@pytest.mark.sanitize
+def test_training_compile_budget_and_determinism(fed_init):
+    """One fused epoch program, traced exactly once, under an active
+    device->host transfer guard -- and bit-identical parameters to an
+    unsanitized run (the J01 batching fix changed no math)."""
+    with sanitize() as counter:
+        tr, params_sane = _fit_params(fed_init)
+        assert counter.count("epoch_local") == len(tr._epoch_fns) == 1
+        assert check_training_budget(tr, counter) == []
+
+    _, params_plain = _fit_params(fed_init)
+    np.testing.assert_array_equal(params_sane, params_plain)
+
+
+# --------------------------------------------------- serving (satellite)
+
+@pytest.fixture(scope="module")
+def serve_model(tmp_path_factory):
+    from fed_tgan_tpu.serve.demo import build_demo_artifact
+    from fed_tgan_tpu.serve.registry import load_model, resolve_artifact
+
+    root = build_demo_artifact(str(tmp_path_factory.mktemp("sanitize_art")))
+    return load_model(resolve_artifact(root, log=lambda *a, **k: None))
+
+
+@pytest.mark.serve
+@pytest.mark.sanitize
+def test_serving_compile_budget_and_determinism(serve_model):
+    """A fresh engine serving across >= 2 chunk buckets compiles at most
+    one program per bucket, and sanitized output is byte-identical."""
+    from fed_tgan_tpu.serve.engine import SamplingEngine
+
+    B = serve_model.synth.cfg.batch_size
+    with sanitize() as counter:
+        eng = SamplingEngine(serve_model)
+        eng.sample_csv_bytes(B, seed=3)          # 1 step  -> bucket 1
+        sane = eng.sample_csv_bytes(3 * B, seed=3)  # 3 steps -> bucket 4
+        eng.sample_csv_bytes(3 * B, seed=4)  # steady state: no new compiles
+        buckets = {name for name in counter.counts(include_noise=True)
+                   if name.startswith("serve_bucket_")}
+        assert len(buckets) == len(eng._programs) >= 2
+        assert check_serving_budget(eng, counter) == []
+
+    plain = SamplingEngine(serve_model).sample_csv_bytes(3 * B, seed=3)
+    assert sane == plain
