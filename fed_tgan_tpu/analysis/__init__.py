@@ -1,15 +1,20 @@
-"""Correctness tooling: static JAX lint (jaxlint) + runtime sanitizers.
+"""Correctness tooling: static lint, runtime sanitizers, IR contracts.
 
-Two prongs, one goal -- keep the hot paths provably clean:
+Three prongs, one goal -- keep the hot paths provably clean:
 
-* :mod:`fed_tgan_tpu.analysis.lint` -- stdlib-AST rules J01-J05 (host
+* :mod:`fed_tgan_tpu.analysis.lint` -- stdlib-AST rules J01-J06 (host
   syncs in hot loops, PRNG key reuse, recompile hazards, numpy-in-jit,
-  unguarded shared state) with a checked-in ratcheting baseline.
-  Run ``python -m fed_tgan_tpu.analysis``.
+  unguarded shared state, dtype promotion) with a checked-in ratcheting
+  baseline.  Run ``python -m fed_tgan_tpu.analysis``.
 * :mod:`fed_tgan_tpu.analysis.sanitizers` -- opt-in runtime guards:
   transfer guards around designated hot regions, a ``log_compiles``
   driven compile counter with per-program budgets, NaN debugging.
   Enabled by ``--sanitize`` on the train/serve CLIs.
+* :mod:`fed_tgan_tpu.analysis.contracts` -- hlolint: every jitted
+  entrypoint AOT-lowered on an 8-virtual-device CPU mesh and its
+  StableHLO fingerprint (collectives, transfer surface, dtype census)
+  ratcheted against checked-in contracts.  Run ``python -m
+  fed_tgan_tpu.analysis --contracts``.
 
 This ``__init__`` stays import-light (no JAX, no numpy) so the lint
 gate and the CLI start instantly.

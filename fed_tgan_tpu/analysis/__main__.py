@@ -1,7 +1,14 @@
-"""``python -m fed_tgan_tpu.analysis`` -- the jaxlint CLI.
+"""``python -m fed_tgan_tpu.analysis`` -- the jaxlint + hlolint CLI.
 
-Exit codes: 0 clean (or all findings baselined), 1 new findings,
-2 usage / parse error.
+Default mode is the static lint (rules J01-J06, no JAX import).
+``--contracts`` switches to the IR program contracts: every jitted
+entrypoint is AOT-lowered on a simulated 8-device CPU mesh and its
+fingerprint diffed against the checked-in ``analysis/contracts/*.json``
+(``--contracts-update`` re-records them; ``--explain`` names the op
+delta and candidate source sites).
+
+Exit codes: 0 clean (or all findings baselined / contracts honored),
+1 new findings / contract regression, 2 usage, parse, or lowering error.
 """
 
 from __future__ import annotations
@@ -25,7 +32,8 @@ from fed_tgan_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m fed_tgan_tpu.analysis",
-        description="JAX-aware lint (J01-J05) over fed_tgan_tpu",
+        description="JAX-aware lint (J01-J06) and lowered-HLO program "
+                    "contracts (--contracts) over fed_tgan_tpu",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the package)")
@@ -38,11 +46,36 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--rules", default="",
                     help="comma-separated rule ids to run (default: all)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--contracts", action="store_true",
+                    help="check the lowered-HLO program contracts instead "
+                         "of linting (AOT-lowers every jitted entrypoint "
+                         "on a simulated 8-device CPU mesh)")
+    ap.add_argument("--contracts-update", action="store_true",
+                    help="re-record the contract fingerprints from the "
+                         "current tree (the explicit ratchet reset)")
+    ap.add_argument("--explain", action="store_true",
+                    help="with --contracts: name each regression's op "
+                         "delta and candidate source sites")
+    ap.add_argument("--contracts-dir", type=Path, default=None,
+                    help="contract JSON directory (default: the checked-in "
+                         "analysis/contracts/)")
     return ap
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.contracts or args.contracts_update:
+        # imported lazily: the contracts prong needs JAX, the lint prong
+        # must keep its millisecond no-JAX startup
+        from fed_tgan_tpu.analysis.contracts.check import run_contracts
+
+        return run_contracts(
+            update=args.contracts_update,
+            explain=args.explain,
+            fmt=args.format,
+            contracts_dir=args.contracts_dir,
+        )
 
     rules = None
     if args.rules:

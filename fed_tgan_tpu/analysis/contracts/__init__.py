@@ -1,0 +1,33 @@
+"""IR-level program contracts (hlolint).
+
+jaxlint (J01-J06) sees Python source; this package sees what the
+compiler actually emitted.  Every jitted entrypoint -- the fused
+federated epoch per trainer variant, the shard_map robust-aggregation
+programs, the serve bucket programs -- is AOT-lowered on a simulated
+8-device CPU mesh (no accelerator needed) and its StableHLO text is
+walked into a structured *fingerprint*: per-collective op counts and
+payload bytes, host<->device transfer surface, dtype census, donation
+aliasing.  Fingerprints are checked in as ``*.json`` next to this file
+and enforced as a two-sided ratchet: a regression (extra collective,
+more transfer bytes, an f64 upcast) fails CI; an improvement passes
+with a stale-contract warning until ``--contracts-update`` re-records
+it.
+
+Run ``python -m fed_tgan_tpu.analysis --contracts``.
+
+Submodules:
+
+* :mod:`.ir`      -- StableHLO text -> :class:`~.ir.Fingerprint`
+  (pure stdlib; no JAX import).
+* :mod:`.harness` -- hermetic lowering of every entrypoint family over
+  synthetic specs/data (JAX imported lazily, CPU-only).
+* :mod:`.check`   -- contract persistence, two-sided diff, ``--explain``
+  rendering with candidate source sites, CLI exit-code policy.
+"""
+
+from fed_tgan_tpu.analysis.contracts.ir import (  # noqa: F401
+    Fingerprint,
+    fingerprint_text,
+)
+
+__all__ = ["Fingerprint", "fingerprint_text"]

@@ -1,0 +1,355 @@
+"""Contract persistence, two-sided diffing, and the CLI policy.
+
+One JSON file per entrypoint family lives next to this module (e.g.
+``train_federated.json``); each holds the recorded
+:class:`~fed_tgan_tpu.analysis.contracts.ir.Fingerprint` per program
+plus the family's forbidden-dtype list.  The diff is a TWO-SIDED
+ratchet:
+
+* **regression** (exit 1): a collective op appeared or grew (count or
+  payload bytes), the host<->device transfer surface grew, donation
+  aliasing was lost, a forbidden dtype (f64 by default) crept in, a
+  contracted program vanished from the harness, or a new program has no
+  contract;
+* **improvement** (exit 0 + stale-contract warning): the same metrics
+  moved the *good* way -- the contract is stale and should be
+  re-recorded with ``--contracts-update`` so the better number becomes
+  the new ceiling;
+* **drift** (exit 0, informational): benign census changes (non-
+  forbidden dtype tallies).
+
+``--explain`` augments each regression with the op delta and candidate
+source sites grepped from the family's subsystem directories.
+Exit codes: 0 clean/improved, 1 regression, 2 lowering unavailable or
+unreadable contracts.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+from fed_tgan_tpu.analysis.contracts.harness import (
+    ENTRYPOINT_FAMILIES,
+    HarnessError,
+    lower_fingerprints,
+)
+from fed_tgan_tpu.analysis.contracts.ir import Fingerprint
+
+__all__ = [
+    "CONTRACTS_DIR",
+    "ContractError",
+    "Issue",
+    "diff_contracts",
+    "load_contracts",
+    "run_contracts",
+    "save_contracts",
+]
+
+CONTRACTS_DIR = Path(__file__).resolve().parent
+DEFAULT_FORBID_DTYPES = ("f64",)
+
+REGRESSION = "regression"
+IMPROVEMENT = "improvement"
+DRIFT = "drift"
+
+
+class ContractError(RuntimeError):
+    """Unreadable / malformed contract file (CLI exit code 2)."""
+
+
+@dataclass
+class Issue:
+    severity: str  # regression | improvement | drift
+    family: str
+    program: str
+    metric: str    # e.g. "collectives.all_gather.count"
+    old: object
+    new: object
+    message: str
+    sites: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"severity": self.severity, "family": self.family,
+                "program": self.program, "metric": self.metric,
+                "old": self.old, "new": self.new,
+                "message": self.message, "sites": self.sites}
+
+    def render(self, explain: bool = False) -> str:
+        head = (f"{self.severity.upper()} {self.family}/{self.program}: "
+                f"{self.metric} {self.old} -> {self.new} ({self.message})")
+        if explain and self.sites:
+            head += "\n    candidate source sites:" + "".join(
+                f"\n      {s}" for s in self.sites)
+        return head
+
+
+# --------------------------------------------------------------- storage
+
+def _family_path(family: str, contracts_dir: Optional[Path] = None) -> Path:
+    return Path(contracts_dir or CONTRACTS_DIR) / f"{family}.json"
+
+
+def load_contracts(families, contracts_dir: Optional[Path] = None
+                   ) -> Dict[str, Optional[dict]]:
+    """family -> {"programs": {...}, "forbid_dtypes": [...]} or None when
+    the family has no contract file yet."""
+    out: Dict[str, Optional[dict]] = {}
+    for family in families:
+        path = _family_path(family, contracts_dir)
+        if not path.exists():
+            out[family] = None
+            continue
+        try:
+            data = json.loads(path.read_text())
+            data["programs"]  # noqa: B018 -- shape check
+        except (OSError, json.JSONDecodeError, KeyError) as exc:
+            raise ContractError(f"bad contract {path}: {exc!r}") from exc
+        out[family] = data
+    return out
+
+
+def save_contracts(current: Dict[str, Dict[str, Fingerprint]],
+                   contracts_dir: Optional[Path] = None) -> List[Path]:
+    paths = []
+    for family, programs in sorted(current.items()):
+        payload = {
+            "version": 1,
+            "comment": ("lowered-HLO program contract; regenerate with "
+                        "python -m fed_tgan_tpu.analysis "
+                        "--contracts-update"),
+            "forbid_dtypes": list(DEFAULT_FORBID_DTYPES),
+            "programs": {name: fp.to_dict()
+                         for name, fp in sorted(programs.items())},
+        }
+        path = _family_path(family, contracts_dir)
+        path.write_text(json.dumps(payload, indent=2, sort_keys=True)
+                        + "\n")
+        paths.append(path)
+    return paths
+
+
+# ------------------------------------------------------------------ diff
+
+def _metric_issue(family, program, metric, old, new, grow_is_bad,
+                  what) -> Optional[Issue]:
+    if new == old:
+        return None
+    grew = new > old
+    bad = grew == grow_is_bad
+    delta = new - old
+    return Issue(
+        severity=REGRESSION if bad else IMPROVEMENT,
+        family=family, program=program, metric=metric, old=old, new=new,
+        message=f"{'+' if delta > 0 else ''}{delta} {what}",
+    )
+
+
+def diff_program(family: str, program: str, stored: dict,
+                 current: Fingerprint,
+                 forbid_dtypes=DEFAULT_FORBID_DTYPES) -> List[Issue]:
+    issues: List[Issue] = []
+    add = issues.append
+
+    # ------------------------------------------------------- collectives
+    old_c = stored.get("collectives", {})
+    new_c = current.collectives
+    for op in sorted(set(old_c) | set(new_c)):
+        o = old_c.get(op, {"count": 0, "bytes": 0})
+        n = new_c.get(op, {"count": 0, "bytes": 0})
+        for f, what in (("count", f"{op} op(s)"),
+                        ("bytes", f"{op} payload byte(s)")):
+            iss = _metric_issue(family, program,
+                               f"collectives.{op}.{f}",
+                               o.get(f, 0), n.get(f, 0),
+                               grow_is_bad=True, what=what)
+            if iss:
+                add(iss)
+
+    # --------------------------------------------------------- transfers
+    old_t = stored.get("transfers", {})
+    new_t = current.transfers
+    for f, what in (("n_inputs", "program input(s)"),
+                    ("in_bytes", "input byte(s)"),
+                    ("n_outputs", "program output(s)"),
+                    ("out_bytes", "output byte(s)")):
+        iss = _metric_issue(family, program, f"transfers.{f}",
+                           old_t.get(f, 0), new_t.get(f, 0),
+                           grow_is_bad=True, what=what)
+        if iss:
+            add(iss)
+    # donation aliasing saves a transfer: LOSING it is the regression
+    iss = _metric_issue(family, program, "transfers.donated_args",
+                       old_t.get("donated_args", 0),
+                       new_t.get("donated_args", 0),
+                       grow_is_bad=False, what="donated operand(s)")
+    if iss:
+        add(iss)
+
+    # ------------------------------------------------------------ dtypes
+    old_d = stored.get("dtypes", {})
+    new_d = current.dtypes
+    for dt in sorted(set(old_d) | set(new_d)):
+        o, n = old_d.get(dt, 0), new_d.get(dt, 0)
+        if o == n:
+            continue
+        if dt in forbid_dtypes:
+            iss = _metric_issue(family, program, f"dtypes.{dt}", o, n,
+                               grow_is_bad=True,
+                               what=f"{dt} tensor type(s) "
+                                    f"({dt} is forbidden here)")
+            if iss:
+                add(iss)
+        else:
+            add(Issue(severity=DRIFT, family=family, program=program,
+                      metric=f"dtypes.{dt}", old=o, new=n,
+                      message=f"{dt} census moved (informational)"))
+    return issues
+
+
+def diff_contracts(current: Dict[str, Dict[str, Fingerprint]],
+                   stored: Dict[str, Optional[dict]]) -> List[Issue]:
+    issues: List[Issue] = []
+    for family, programs in sorted(current.items()):
+        fam = stored.get(family)
+        if fam is None:
+            issues.append(Issue(
+                severity=REGRESSION, family=family, program="*",
+                metric="contract", old="missing", new=f"{len(programs)} "
+                "program(s)",
+                message="no contract file; record one with "
+                        "--contracts-update"))
+            continue
+        recorded = fam.get("programs", {})
+        forbid = tuple(fam.get("forbid_dtypes", DEFAULT_FORBID_DTYPES))
+        for name in sorted(set(recorded) | set(programs)):
+            if name not in programs:
+                issues.append(Issue(
+                    severity=REGRESSION, family=family, program=name,
+                    metric="contract", old="recorded", new="missing",
+                    message="contracted entrypoint no longer lowered by "
+                            "the harness (renamed? update the contract)"))
+            elif name not in recorded:
+                issues.append(Issue(
+                    severity=REGRESSION, family=family, program=name,
+                    metric="contract", old="missing", new="present",
+                    message="new entrypoint without a contract; record "
+                            "it with --contracts-update"))
+            else:
+                issues.extend(diff_program(family, name, recorded[name],
+                                           programs[name], forbid))
+    return issues
+
+
+# --------------------------------------------------------------- explain
+
+#: where each family's program logic lives -- the grep scope for
+#: candidate source sites of a regression.
+_FAMILY_DIRS = {
+    "train_federated": ("train", "parallel", "ops", "models"),
+    "parallel_fedavg": ("parallel",),
+    "serve_engine": ("serve", "ops", "models"),
+}
+
+_SITE_PATTERNS = {
+    "collectives": re.compile(
+        r"all_gather|psum|pmin|pmax|all_to_all|ppermute|reduce_scatter"
+        r"|weighted_average|robust_aggregate"),
+    "transfers": re.compile(
+        r"device_get|device_put|copy_to_host_async|block_until_ready"
+        r"|np\.asarray"),
+    "dtypes": re.compile(r"float64|f64|astype\(\s*float\s*\)"),
+}
+
+_MAX_SITES = 5
+
+
+def _candidate_sites(issue: Issue) -> List[str]:
+    kind = issue.metric.split(".", 1)[0]
+    pattern = _SITE_PATTERNS.get(kind)
+    if pattern is None:
+        return []
+    from fed_tgan_tpu.analysis.lint import PKG_ROOT, REPO_ROOT
+
+    dirs = _FAMILY_DIRS.get(issue.family, ())
+    roots = [PKG_ROOT / d for d in dirs if (PKG_ROOT / d).is_dir()]
+    sites: List[str] = []
+    for root in roots or [PKG_ROOT]:
+        for path in sorted(root.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            try:
+                lines = path.read_text().splitlines()
+            except OSError:
+                continue
+            rel = path.relative_to(REPO_ROOT).as_posix()
+            for i, line in enumerate(lines, 1):
+                if pattern.search(line):
+                    sites.append(f"{rel}:{i}: {line.strip()[:90]}")
+                    if len(sites) >= _MAX_SITES:
+                        return sites
+    return sites
+
+
+# ------------------------------------------------------------ run policy
+
+def run_contracts(update: bool = False, explain: bool = False,
+                  fmt: str = "text",
+                  contracts_dir: Optional[Path] = None,
+                  entrypoints: Optional[Dict[str, Dict[str, Callable]]]
+                  = None,
+                  out: Callable[[str], None] = print) -> int:
+    """Lower, diff (or re-record), report.  Returns the exit code."""
+    try:
+        current = lower_fingerprints(entrypoints)
+    except HarnessError as exc:
+        out(f"contracts: lowering unavailable: {exc}")
+        return 2
+
+    if update:
+        paths = save_contracts(current, contracts_dir)
+        n = sum(len(p) for p in current.values())
+        out(f"contracts: recorded {n} program fingerprint(s) across "
+            f"{len(current)} family(ies) -> "
+            + ", ".join(str(p) for p in paths))
+        return 0
+
+    try:
+        stored = load_contracts(current, contracts_dir)
+    except ContractError as exc:
+        out(f"contracts: {exc}")
+        return 2
+    issues = diff_contracts(current, stored)
+    regressions = [i for i in issues if i.severity == REGRESSION]
+    improvements = [i for i in issues if i.severity == IMPROVEMENT]
+    drift = [i for i in issues if i.severity == DRIFT]
+    if explain:
+        for i in regressions:
+            i.sites = _candidate_sites(i)
+
+    if fmt == "json":
+        out(json.dumps({
+            "families": {fam: sorted(progs) for fam, progs in
+                         current.items()},
+            "issues": [i.to_dict() for i in issues],
+            "regressions": len(regressions),
+            "improvements": len(improvements),
+        }, indent=2))
+        return 1 if regressions else 0
+
+    for i in regressions:
+        out(i.render(explain=explain))
+    for i in improvements:
+        out(i.render() + "\n    stale contract: re-record the better "
+            "number with --contracts-update")
+    for i in drift:
+        out(i.render())
+    n_prog = sum(len(p) for p in current.values())
+    out(f"contracts: {n_prog} program(s) across {len(current)} "
+        f"family(ies): {len(regressions)} regression(s), "
+        f"{len(improvements)} improvement(s) (stale contracts), "
+        f"{len(drift)} census drift(s)")
+    return 1 if regressions else 0

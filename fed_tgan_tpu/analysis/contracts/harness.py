@@ -1,0 +1,299 @@
+"""Hermetic AOT-lowering of every contracted jitted entrypoint.
+
+Each builder constructs its program exactly the way the production
+caller does -- ``make_federated_epoch`` with stacked client tables,
+``robust_aggregate`` inside the same shard_map shape the fused epoch
+uses, the serve engine's ``build_bucket_program`` -- but over a fully
+synthetic table spec (``SegmentSpec.from_output_info``) and
+deterministic synthetic data, so lowering needs no dataset, no fitted
+transformer, and no accelerator: an 8-virtual-device CPU mesh
+(``provision_virtual_cpu(8)``) is enough.  ``.lower()`` traces but never
+executes, so the whole sweep is seconds of CPU.
+
+Coverage note: ``train/multihost.py`` reuses ``make_federated_epoch``
+for its per-host program (only the mesh spans hosts), so the fused-epoch
+contracts cover the multihost program shape too;
+``parallel/multihost.py``'s participant mesh needs a multi-process world
+and cannot be lowered in-process.
+
+JAX is imported lazily so the lint prong of ``python -m
+fed_tgan_tpu.analysis`` keeps its no-JAX startup.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from fed_tgan_tpu.analysis.contracts.ir import Fingerprint, fingerprint_text
+from fed_tgan_tpu.serve.naming import serve_bucket_name
+
+__all__ = [
+    "ENTRYPOINT_FAMILIES",
+    "HarnessError",
+    "N_DEVICES",
+    "lower_fingerprints",
+    "require_mesh",
+]
+
+N_DEVICES = 8  #: simulated mesh width; matches the tests/CI recipe
+
+#: the synthetic table every builder shares: two continuous columns
+#: (tanh scalar + mode one-hot is modeled as tanh segments here) and two
+#: discrete ones -- wide enough to exercise every segment op, small
+#: enough that lowering is instant.
+_OUTPUT_INFO = ((1, "tanh"), (3, "softmax"), (1, "tanh"), (4, "softmax"))
+_ROWS = 16  #: per-client rows -> 2 local steps at batch_size 8
+
+
+class HarnessError(RuntimeError):
+    """Lowering unavailable on this host (CLI exit code 2)."""
+
+
+def require_mesh(n: int = N_DEVICES) -> None:
+    """Ensure >= ``n`` CPU devices exist, provisioning a virtual CPU
+    platform when no backend is initialized yet.  Raises
+    :class:`HarnessError` when the process is already bound to an
+    unsuitable backend (e.g. a 1-device accelerator)."""
+    try:
+        import jax
+
+        from fed_tgan_tpu.parallel.mesh import (
+            backend_initialized,
+            provision_virtual_cpu,
+        )
+    except Exception as exc:  # pragma: no cover - broken install
+        raise HarnessError(f"jax unavailable: {exc!r}") from exc
+    if backend_initialized():
+        devices = jax.devices()
+        if len(devices) < n:
+            raise HarnessError(
+                f"need {n} devices to lower the mesh programs, have "
+                f"{len(devices)} ({devices[0].platform}); run in a fresh "
+                f"process with XLA_FLAGS=--xla_force_host_platform_"
+                f"device_count={n} JAX_PLATFORMS=cpu"
+            )
+        return
+    try:
+        provision_virtual_cpu(n)
+    except Exception as exc:
+        raise HarnessError(f"could not provision {n} virtual CPU "
+                           f"devices: {exc}") from exc
+
+
+# ------------------------------------------------------------ toy inputs
+
+def _toy_spec():
+    from fed_tgan_tpu.ops.segments import SegmentSpec
+
+    return SegmentSpec.from_output_info(_OUTPUT_INFO)
+
+
+def _toy_cfg(**overrides):
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    kw = dict(embedding_dim=4, gen_dims=(8,), dis_dims=(8,),
+              batch_size=8, pac=2)
+    kw.update(overrides)
+    return TrainConfig(**kw)
+
+
+def _toy_matrix(spec, seed: int, rows: int = _ROWS) -> np.ndarray:
+    """A deterministic transformed matrix: uniform tanh scalars, one-hot
+    discrete blocks covering every option (values only seed the sampler
+    tables -- the program shape never depends on them)."""
+    rng = np.random.RandomState(seed)
+    mat = np.zeros((rows, spec.dim), dtype=np.float32)
+    tanh_dims = np.flatnonzero(spec.is_tanh_dim)
+    mat[:, tanh_dims] = rng.uniform(-1.0, 1.0, (rows, len(tanh_dims)))
+    for c in range(spec.n_discrete):
+        lo = spec.cond_offsets[c]
+        dims = spec.discrete_dims[lo:lo + spec.cond_sizes[c]]
+        # round-robin base guarantees every option occurs in every shard
+        choice = (np.arange(rows) + rng.randint(0, len(dims))) % len(dims)
+        mat[np.arange(rows), dims[choice]] = 1.0
+    return mat
+
+
+def _client_stacks(spec, cfg):
+    from fed_tgan_tpu.train.federated import _stack_samplers
+    from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
+
+    mats = [_toy_matrix(spec, seed=i) for i in range(N_DEVICES)]
+    cond = _stack_samplers([CondSampler.from_data(m, spec) for m in mats])
+    rows = _stack_samplers([RowSampler.from_data(m, spec) for m in mats])
+    data = np.stack(mats)
+    steps = np.full((N_DEVICES,), _ROWS // cfg.batch_size, dtype=np.int32)
+    weights = np.full((N_DEVICES,), 1.0 / N_DEVICES, dtype=np.float32)
+    return data, cond, rows, steps, weights
+
+
+def _stacked_models(spec, cfg):
+    import jax
+
+    from fed_tgan_tpu.train.steps import init_models
+
+    one = init_models(jax.random.key(0), spec, cfg)
+    return one, jax.tree.map(
+        lambda x: np.broadcast_to(
+            np.asarray(x)[None], (N_DEVICES,) + np.shape(x)).copy(),
+        one,
+    )
+
+
+# ------------------------------------------- entrypoint family builders
+
+#: fused-epoch trainer variants: cfg deltas relative to _toy_cfg().
+#: "weighted" disables the gate so the legacy single-psum program
+#: (bit-identical to pre-robust builds) stays under contract alongside
+#: the gated/median robust programs and the EMA signature variant.
+_EPOCH_VARIANTS = {
+    "weighted": dict(update_gate=False),
+    "gated": dict(),
+    "median": dict(aggregator="median"),
+    "ema": dict(update_gate=False, ema_decay=0.999),
+}
+
+
+def _lower_epoch(variant: str):
+    import jax
+
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.train.federated import make_federated_epoch
+
+    require_mesh()
+    spec = _toy_spec()
+    cfg = _toy_cfg(**_EPOCH_VARIANTS[variant])
+    mesh = client_mesh(N_DEVICES)
+    data, cond, rows, steps, weights = _client_stacks(spec, cfg)
+    one, models = _stacked_models(spec, cfg)
+    # rounds=2 exercises the round scan; collectives inside lax.scan
+    # appear once in the IR regardless of length
+    fn = make_federated_epoch(spec, cfg, max_steps=int(steps.max()),
+                              mesh=mesh, k=1, rounds=2)
+    args = [models, data, cond, rows, steps, weights, jax.random.key(0)]
+    if cfg.ema_decay > 0.0:
+        args.append(jax.tree.map(lambda x: np.zeros_like(np.asarray(x)),
+                                 (one.params_g, one.state_g)))
+    return fn.lower(*args)
+
+
+def _agg_trees():
+    """A two-leaf pytree with the (n_clients, k, ...) layout
+    robust_aggregate sees inside the fused epoch."""
+    prev = {"w": np.zeros((N_DEVICES, 1, 4, 3), np.float32),
+            "b": np.zeros((N_DEVICES, 1, 4), np.float32)}
+    new = {"w": np.ones((N_DEVICES, 1, 4, 3), np.float32),
+           "b": np.ones((N_DEVICES, 1, 4), np.float32)}
+    weights = np.full((N_DEVICES,), 1.0 / N_DEVICES, np.float32)
+    steps = np.ones((N_DEVICES,), np.int32)
+    return prev, new, weights, steps
+
+
+def _lower_robust(aggregator: str):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from fed_tgan_tpu.parallel.fedavg import robust_aggregate
+    from fed_tgan_tpu.parallel.mesh import (
+        CLIENTS_AXIS,
+        client_mesh,
+        shard_map,
+    )
+
+    require_mesh()
+    mesh = client_mesh(N_DEVICES)
+
+    def prog(prev, new, w, s):
+        return robust_aggregate(prev, new, w, s, k=1,
+                                aggregator=aggregator)
+
+    fn = shard_map(
+        prog, mesh=mesh,
+        in_specs=(P(CLIENTS_AXIS),) * 4,
+        out_specs=(P(), P(CLIENTS_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(fn).lower(*_agg_trees())
+
+
+def _lower_weighted_psum():
+    """The legacy aggregation: one psum of weight-scaled leaves."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from fed_tgan_tpu.parallel.fedavg import weighted_average
+    from fed_tgan_tpu.parallel.mesh import (
+        CLIENTS_AXIS,
+        client_mesh,
+        shard_map,
+    )
+
+    require_mesh()
+    mesh = client_mesh(N_DEVICES)
+    fn = shard_map(
+        lambda t, w: weighted_average(t, w),
+        mesh=mesh,
+        in_specs=(P(CLIENTS_AXIS), P(CLIENTS_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    prev, _new, weights, _steps = _agg_trees()
+    return jax.jit(fn).lower(prev, weights)
+
+
+def _lower_serve(n_steps: int, conditional: bool):
+    import jax
+
+    from fed_tgan_tpu.models.ctgan import init_generator
+    from fed_tgan_tpu.serve.engine import build_bucket_program
+    from fed_tgan_tpu.train.sampler import CondSampler
+
+    require_mesh()
+    spec = _toy_spec()
+    cfg = _toy_cfg()
+    run = build_bucket_program(spec, cfg, None, n_steps, conditional)
+    params_g, state_g = init_generator(
+        jax.random.key(1), cfg.embedding_dim + spec.n_opt, cfg.gen_dims,
+        spec.dim)
+    cond = CondSampler.from_data(_toy_matrix(spec, seed=0), spec)
+    return jax.jit(run).lower(params_g, state_g, cond, jax.random.key(0),
+                              np.int32(0), np.int32(0))
+
+
+#: family -> {program name -> zero-arg builder returning a Lowered}.
+#: Contract JSON files are named after the family keys.
+ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
+    "train_federated": {
+        f"fused_epoch[{v}]": (lambda v=v: _lower_epoch(v))
+        for v in _EPOCH_VARIANTS
+    },
+    "parallel_fedavg": {
+        "fedavg[weighted_psum]": _lower_weighted_psum,
+        **{f"robust_agg[{a}]": (lambda a=a: _lower_robust(a))
+           for a in ("weighted", "clipped", "trimmed", "median")},
+    },
+    "serve_engine": {
+        serve_bucket_name(n, c): (lambda n=n, c=c: _lower_serve(n, c))
+        for n in (1, 4) for c in (False, True)
+    },
+}
+
+
+def lower_fingerprints(
+    families: Optional[Dict[str, Dict[str, Callable]]] = None,
+) -> Dict[str, Dict[str, Fingerprint]]:
+    """Lower every entrypoint and fingerprint its StableHLO text.
+
+    ``families`` overrides the registry (tests inject tiny programs); a
+    builder may return a Lowered (``.as_text()``) or the text itself.
+    """
+    out: Dict[str, Dict[str, Fingerprint]] = {}
+    for family, programs in (families or ENTRYPOINT_FAMILIES).items():
+        out[family] = {}
+        for name, build in programs.items():
+            lowered = build()
+            text = lowered if isinstance(lowered, str) else lowered.as_text()
+            out[family][name] = fingerprint_text(text)
+    return out

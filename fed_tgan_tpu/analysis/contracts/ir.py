@@ -1,0 +1,203 @@
+"""StableHLO/MLIR text -> structured program fingerprint.
+
+Pure stdlib text analysis: lowering happens elsewhere (the harness);
+this module only reads the ``.as_text()`` dump.  The extracted facts are
+deliberately coarse -- op counts, byte totals, dtype tallies -- because
+the contract diff must be stable across benign refactors yet catch the
+three silent cost regressions jaxlint structurally cannot see:
+
+* an extra collective (all_gather/all_reduce/...) or a fatter payload;
+* a bigger host<->device transfer surface (more/larger main() operands
+  or results, lost donation aliasing);
+* a dtype promotion (f64 creeping into an f32 program).
+
+Parsing notes (verified against jax 0.4.x StableHLO dumps):
+
+* collectives appear as ``"stablehlo.all_reduce"(...)``; ops with a
+  reduction region close with ``}) : (operand types) -> result type``
+  while single-line ops carry the signature inline.  Region bodies never
+  contain ``->``, so the first ``-> <type>`` after the op name is that
+  op's own result signature.
+* ``func.func public @main(...)`` declares the program's transfer
+  surface; donated operands carry ``tf.aliasing_output`` /
+  ``jax.buffer_donor`` arg attributes.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+__all__ = ["Fingerprint", "fingerprint_text", "tensor_nbytes"]
+
+#: bytes per element for the dtypes XLA emits; unknown dtypes count as 0
+#: bytes (they still show in the census, so a contract catches them).
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8E4M3FN": 1, "f8E5M2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1,
+    "i4": 1, "ui4": 1, "i1": 1,
+    "c64": 8, "c128": 16,
+}
+
+#: the cross-device communication ops a contract ratchets.
+COLLECTIVE_OPS = (
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "all_to_all",
+    "collective_permute",
+    "collective_broadcast",
+)
+
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*)([A-Za-z][A-Za-z0-9]*)>")
+_COLLECTIVE_RE = re.compile(
+    r'"(?:stablehlo|mhlo)\.(%s)"' % "|".join(COLLECTIVE_OPS))
+#: an op's function-type signature: single result or a result tuple.
+_ARROW_RE = re.compile(r"->\s*(\([^)]*\)|tensor<[^>]+>)")
+_MAIN_RE = re.compile(r"func\.func\s+(?:public\s+)?@main\(")
+_DONATION_RE = re.compile(r"tf\.aliasing_output|jax\.buffer_donor")
+
+
+def tensor_nbytes(dims: str, dtype: str) -> int:
+    """Byte size of one ``tensor<DIMSxDTYPE>`` occurrence."""
+    n = 1
+    for d in dims.split("x"):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 0)
+
+
+def _types_bytes(fragment: str) -> Tuple[int, int]:
+    """(tensor count, total bytes) over every tensor type in ``fragment``."""
+    count = total = 0
+    for dims, dtype in _TENSOR_RE.findall(fragment):
+        count += 1
+        total += tensor_nbytes(dims, dtype)
+    return count, total
+
+
+@dataclass
+class Fingerprint:
+    """The contract-relevant shape of one lowered program."""
+
+    #: op name -> {"count": occurrences, "bytes": summed result bytes}
+    collectives: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    #: n_inputs / in_bytes / n_outputs / out_bytes / donated_args
+    transfers: Dict[str, int] = field(default_factory=dict)
+    #: dtype -> number of tensor-type occurrences in the module text
+    dtypes: Dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "collectives": {k: dict(v) for k, v in
+                            sorted(self.collectives.items())},
+            "transfers": dict(sorted(self.transfers.items())),
+            "dtypes": dict(sorted(self.dtypes.items())),
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Fingerprint":
+        return cls(
+            collectives={k: dict(v) for k, v in
+                         data.get("collectives", {}).items()},
+            transfers=dict(data.get("transfers", {})),
+            dtypes=dict(data.get("dtypes", {})),
+        )
+
+
+def _split_top_level(s: str) -> List[str]:
+    """Split on commas outside (), [], {} and <> nesting."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch in "([{<":
+            depth += 1
+        elif ch in ")]}>":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    tail = s[start:].strip()
+    if tail:
+        parts.append(tail)
+    return [p for p in (p.strip() for p in parts) if p]
+
+
+def _main_signature(text: str) -> Tuple[str, str]:
+    """(argument list, result fragment) of ``@main``, or ("", "")."""
+    m = _MAIN_RE.search(text)
+    if not m:
+        return "", ""
+    i = m.end()  # just past the opening paren of the arg list
+    depth = 1
+    j = i
+    while j < len(text) and depth:
+        if text[j] == "(":
+            depth += 1
+        elif text[j] == ")":
+            depth -= 1
+        j += 1
+    args = text[i:j - 1]
+    # optional "-> <results>" between the arg list and the body brace
+    rest = text[j:]
+    brace = rest.find("{")
+    head = rest[:brace if brace >= 0 else len(rest)]
+    arrow = head.find("->")
+    results = head[arrow + 2:] if arrow >= 0 else ""
+    # a result list "(type {attrs}, ...)" re-opens parens; take through
+    # the matching close so multi-result programs keep every entry
+    if arrow >= 0 and "(" in results:
+        k = rest.find("(", arrow)
+        depth, e = 0, k
+        while e < len(rest):
+            if rest[e] == "(":
+                depth += 1
+            elif rest[e] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            e += 1
+        results = rest[k:e + 1]
+    return args, results
+
+
+def fingerprint_text(text: str) -> Fingerprint:
+    """Walk one module's StableHLO text into a :class:`Fingerprint`."""
+    fp = Fingerprint()
+
+    # ------------------------------------------------------- collectives
+    for m in _COLLECTIVE_RE.finditer(text):
+        op = m.group(1)
+        entry = fp.collectives.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        # region bodies contain no "->", so the first arrow after the op
+        # name is this op's own (operands) -> results signature
+        sig = _ARROW_RE.search(text, m.end())
+        if sig:
+            _, nbytes = _types_bytes(sig.group(1))
+            entry["bytes"] += nbytes
+
+    # --------------------------------------------------------- transfers
+    args, results = _main_signature(text)
+    n_in = in_bytes = donated = 0
+    for arg in _split_top_level(args):
+        c, b = _types_bytes(arg)
+        n_in += c
+        in_bytes += b
+        if _DONATION_RE.search(arg):
+            donated += 1
+    n_out, out_bytes = _types_bytes(results)
+    fp.transfers = {
+        "n_inputs": n_in,
+        "in_bytes": in_bytes,
+        "n_outputs": n_out,
+        "out_bytes": out_bytes,
+        "donated_args": donated,
+    }
+
+    # ------------------------------------------------------ dtype census
+    for _, dtype in _TENSOR_RE.findall(text):
+        fp.dtypes[dtype] = fp.dtypes.get(dtype, 0) + 1
+    return fp

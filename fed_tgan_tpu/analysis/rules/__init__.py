@@ -4,6 +4,7 @@ Each rule exposes ``rule_id``, ``title``, ``hint`` and
 ``check(module) -> iter[(rule_id, line, message, hint)]``.
 """
 
+from fed_tgan_tpu.analysis.rules.dtype_promotion import DtypePromotionRule
 from fed_tgan_tpu.analysis.rules.host_sync import HostSyncRule
 from fed_tgan_tpu.analysis.rules.numpy_in_jit import NumpyInJitRule
 from fed_tgan_tpu.analysis.rules.prng_reuse import PrngReuseRule
@@ -16,9 +17,11 @@ ALL_RULES = (
     RecompileRule(),
     NumpyInJitRule(),
     SharedStateRule(),
+    DtypePromotionRule(),
 )
 
 RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}
 
-__all__ = ["ALL_RULES", "RULES_BY_ID", "HostSyncRule", "PrngReuseRule",
-           "RecompileRule", "NumpyInJitRule", "SharedStateRule"]
+__all__ = ["ALL_RULES", "RULES_BY_ID", "DtypePromotionRule", "HostSyncRule",
+           "NumpyInJitRule", "PrngReuseRule", "RecompileRule",
+           "SharedStateRule"]

@@ -1,0 +1,140 @@
+"""J06 -- dtype-promotion hazards inside jitted code.
+
+Two silent ways an f32 program grows f64 (or lies about it):
+
+* a host ``np.float64`` / ``np.double`` scalar (or a dtype-less
+  ``np.array``/``np.asarray`` over float literals -- numpy defaults them
+  to f64) combined with a traced value: under ``jax_enable_x64`` the
+  whole expression promotes to f64 (double the collective payload, half
+  the TPU throughput); without x64 the requested precision silently
+  degrades to f32 -- either way the source stops meaning what it says;
+* an explicit ``dtype=np.float64`` / ``dtype="float64"`` /
+  ``dtype=float`` keyword inside jit -- the same two-faced request,
+  spelled directly.
+
+Plain Python float literals (``x * 2.0``) stay CLEAN: they are
+weak-typed in JAX and inherit the traced operand's dtype -- that is the
+sanctioned idiom the hint points at.  ``np.float64`` applied directly
+TO a traced value is J04's finding (host numpy on a tracer), not ours;
+this rule covers the constant-side operand J04 deliberately ignores.
+
+The IR-level twin of this rule is the contracts dtype census
+(``python -m fed_tgan_tpu.analysis --contracts`` flags f64 tensor types
+in the lowered programs); J06 catches the hazard at the source line
+before it ever lowers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fed_tgan_tpu.analysis.rules.base import (
+    dotted,
+    jitted_functions,
+    names_in,
+)
+
+RULE_ID = "J06"
+HINT = ("use weak-typed Python scalars (x * 2.0) or explicit jnp dtypes "
+        "(jnp.float32) inside jit; host f64 scalars promote under x64 "
+        "and silently degrade without it")
+
+#: numpy spellings that produce a strong f64 host scalar/array.
+_F64_CALLS = {"np.float64", "numpy.float64", "onp.float64",
+              "np.double", "numpy.double", "onp.double"}
+#: dtype-less array constructors numpy defaults to f64 on float input.
+_ARRAY_CALLS = {"np.array", "np.asarray", "numpy.array", "numpy.asarray",
+                "onp.array", "onp.asarray"}
+
+
+def _is_f64_operand(node) -> bool:
+    """A call producing a strong f64 value from CONSTANTS (a traced
+    argument is J04's finding, not a promotion-by-constant)."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func) or ""
+    if d in _F64_CALLS:
+        return not any(names_in(a) for a in node.args)
+    if d in _ARRAY_CALLS:
+        has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+        has_float_literal = any(
+            isinstance(n, ast.Constant) and isinstance(n.value, float)
+            for a in node.args for n in ast.walk(a)
+        )
+        return (not has_dtype and has_float_literal
+                and not any(names_in(a) for a in node.args))
+    return False
+
+
+def _f64_dtype_kwarg(call: ast.Call):
+    """The dtype kwarg value when it requests f64 (or builtin float)."""
+    for kw in call.keywords:
+        if kw.arg != "dtype":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and v.value in ("float64", "double"):
+            return "dtype=\"float64\""
+        if isinstance(v, ast.Name) and v.id == "float":
+            return "dtype=float"
+        d = dotted(v) or ""
+        if d in _F64_CALLS or d.endswith(".float64") or d.endswith(".double"):
+            return f"dtype={d}"
+    return None
+
+
+def _taint(jf) -> set:
+    tainted = set(jf.dynamic_params)
+    body = jf.node.body
+    stmts = body if isinstance(body, list) else []
+    for _ in range(2):  # propagate through simple assignments
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) and \
+                        names_in(node.value) & tainted:
+                    for t in node.targets:
+                        tainted |= {n.id for n in ast.walk(t)
+                                    if isinstance(n, ast.Name)}
+                elif isinstance(node, (ast.For, ast.AsyncFor)) and \
+                        names_in(node.iter) & tainted:
+                    tainted |= {n.id for n in ast.walk(node.target)
+                                if isinstance(n, ast.Name)}
+    return tainted
+
+
+class DtypePromotionRule:
+    rule_id = RULE_ID
+    title = "dtype promotion hazard in jit"
+    hint = HINT
+
+    def check(self, mod) -> Iterator:
+        findings: dict = {}
+        for jf in jitted_functions(mod.tree):
+            body = jf.node.body
+            stmts = body if isinstance(body, list) else [ast.Expr(body)]
+            tainted = _taint(jf)
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.BinOp):
+                        for side, other in ((node.left, node.right),
+                                            (node.right, node.left)):
+                            if _is_f64_operand(side) and \
+                                    names_in(other) & tainted:
+                                d = dotted(side.func)
+                                findings.setdefault(
+                                    node.lineno,
+                                    f"{d}() yields a strong float64 "
+                                    "operand: combined with a traced "
+                                    "value it promotes the expression "
+                                    "under x64 (and silently stays f32 "
+                                    "without it)")
+                    elif isinstance(node, ast.Call):
+                        req = _f64_dtype_kwarg(node)
+                        if req is not None:
+                            findings.setdefault(
+                                node.lineno,
+                                f"{req} inside jit requests float64: a "
+                                "silent 2x payload upcast under x64, a "
+                                "silent lie without it")
+        for line in sorted(findings):
+            yield (self.rule_id, line, findings[line], self.hint)

@@ -1,6 +1,6 @@
 """Runtime sanitizers: transfer guards, compile budgets, NaN debugging.
 
-Static lint (J01-J05) proves the *source* is clean; these prove the
+Static lint (J01-J06) proves the *source* is clean; these prove the
 *process* is: with sanitizers enabled, designated hot regions run under
 ``jax.transfer_guard_device_to_host("disallow")`` (an implicit pull
 raises instead of silently costing a round trip -- explicit
@@ -234,14 +234,16 @@ def check_serving_budget(engine, counter=None) -> List[str]:
     """The serve engine compiles at most one program per
     (power-of-two bucket, conditional?) pair -- and each bucket's
     program exactly once."""
+    from fed_tgan_tpu.serve.naming import SERVE_BUCKET_PREFIX
+
     counter = counter or _STATE.counter
     programs = getattr(engine, "_programs", None)
     if counter is None or programs is None:
         return []
     out = check_compile_budgets(
-        {"serve_bucket_": max(1, len(programs))}, counter)
+        {SERVE_BUCKET_PREFIX: max(1, len(programs))}, counter)
     for name, n in counter.counts(include_noise=True).items():
-        if name.startswith("serve_bucket_") and n > 1:
+        if name.startswith(SERVE_BUCKET_PREFIX) and n > 1:
             out.append(f"bucket program '{name}' compiled {n}x "
                        "(budget 1) -- bucket cache miss?")
     return out

@@ -168,7 +168,7 @@ def check_compile_cache() -> bool:
 
 
 def check_static_analysis() -> bool:
-    """The jaxlint gate: AST rules J01-J05 over the package, diffed
+    """The jaxlint gate: AST rules J01-J06 over the package, diffed
     against the checked-in baseline.  Pure stdlib -- no JAX tracing."""
     try:
         from fed_tgan_tpu.analysis.lint import (
@@ -190,7 +190,38 @@ def check_static_analysis() -> bool:
     return _line(True, "static-analysis",
                  f"jaxlint clean: {len(findings)} finding(s) all baselined"
                  f" ({len(stale)} stale baseline entr"
-                 f"{'y' if len(stale) == 1 else 'ies'}, rules J01-J05)")
+                 f"{'y' if len(stale) == 1 else 'ies'}, rules J01-J06)")
+
+
+def check_program_contracts(timeout: int = 300) -> bool:
+    """The hlolint gate: a subprocess lowers every contracted entrypoint
+    on an 8-virtual-device CPU mesh and diffs the StableHLO fingerprints
+    against the checked-in contracts (collectives, transfer surface,
+    dtype census).  Subprocess because lowering must own backend
+    initialization, exactly like :func:`check_virtual_mesh`."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "fed_tgan_tpu.analysis", "--contracts"],
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "program-contracts",
+                     f"timed out after {timeout}s")
+    tail = (proc.stdout or proc.stderr or "").strip().splitlines()
+    summary = tail[-1] if tail else "no output"
+    if proc.returncode == 2:
+        return _line(False, "program-contracts",
+                     f"lowering unavailable: {summary}")
+    if proc.returncode != 0:
+        heads = " | ".join(tail[:2])
+        return _line(False, "program-contracts",
+                     f"{heads} -- run python -m fed_tgan_tpu.analysis "
+                     "--contracts --explain")
+    return _line(True, "program-contracts", summary)
 
 
 def check_robust_aggregation() -> bool:
@@ -418,6 +449,7 @@ def main(argv=None) -> int:
         check_robust_aggregation(),
         check_compile_cache(),
         check_static_analysis(),
+        check_program_contracts(),
         check_serving(),
     ]
     bad = checks.count(False)

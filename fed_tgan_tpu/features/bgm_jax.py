@@ -182,9 +182,11 @@ def fit_columns_jax(
             )
         )
     )
+    # one batched transfer for all seven result arrays (jaxlint J01),
+    # then the float64 view is a host-side dtype conversion
     means, stds, weights, mean_prec, dof, stick_a, stick_b = (
         np.asarray(r, dtype=np.float64)
-        for r in fit(jnp.asarray(xs), jnp.asarray(masks))
+        for r in jax.device_get(fit(jnp.asarray(xs), jnp.asarray(masks)))
     )
     out = []
     for i in range(len(cols)):

@@ -604,14 +604,17 @@ class ClientTransport(_Endpoint):
             try:
                 with self._io_lock:
                     self._send_bytes(0, frame, remaining)
+                    # bookkeeping rides inside the same lock as the send so
+                    # a concurrent reader never sees a frame on the wire
+                    # with stale seq/replay state (jaxlint J05)
+                    self._send_seq = seq
+                    self._last_sent = frame
+                    self._sent_count += 1
                 break
             except DeadlineError:
                 raise
             except TransportError:
                 self._reconnect()
-        self._send_seq = seq
-        self._last_sent = frame
-        self._sent_count += 1
         if plan is not None and plan.should_sever(self.rank, self._sent_count):
             # fault injection: sever our own live connection AFTER a
             # successful send so the next op exercises reconnect+resync

@@ -27,6 +27,7 @@ from typing import Optional
 import numpy as np
 
 from fed_tgan_tpu.analysis.sanitizers import hot_region
+from fed_tgan_tpu.serve.naming import serve_bucket_name
 from fed_tgan_tpu.serve.registry import LoadedModel
 
 
@@ -39,6 +40,61 @@ def _pow2(n: int) -> int:
     while b < n:
         b <<= 1
     return b
+
+
+def build_bucket_program(spec, cfg, decode_fn, n_steps: int,
+                         conditional: bool):
+    """The un-jitted ``n_steps``-step bucket program: fused generator
+    forward + conditional draw + gumbel activation (+ device decode when
+    ``decode_fn`` is given; None returns the activated encoded matrix --
+    the contracts harness lowers that form without a trained
+    transformer).  Named via :func:`serve_bucket_name` so the sanitizer
+    compile budget and the IR contracts key off the same identity.
+
+    Signature of the returned function:
+    ``run(params_g, state_g, cond, key, start, pos)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fed_tgan_tpu.models.ctgan import generator_apply
+    from fed_tgan_tpu.ops.segments import apply_activate
+
+    B, emb = cfg.batch_size, cfg.embedding_dim
+
+    def run(params_g, state_g, cond, key, start, pos):
+        # one step == make_sample_step's draw exactly (kz/kc/ka split
+        # order), so the unconditional stream is bit-identical to
+        # SavedSynthesizer.sample_encoded's schedule
+        def single(k):
+            kz, kc, ka = jax.random.split(k, 3)
+            z = jax.random.normal(kz, (B, emb))
+            if spec.n_discrete > 0:
+                if conditional:
+                    c = jnp.broadcast_to(
+                        (jnp.arange(spec.n_opt) == pos)
+                        .astype(z.dtype)[None, :],
+                        (B, spec.n_opt),
+                    )
+                else:
+                    c = cond.sample_empirical(kc, B)
+                z = jnp.concatenate([z, c], axis=1)
+            raw, _ = generator_apply(params_g, state_g, z, train=False)
+            return apply_activate(raw, spec, ka)
+
+        def body(carry, i):
+            return carry, single(jax.random.fold_in(key, start + i))
+
+        _, out = jax.lax.scan(body, None, jnp.arange(n_steps))
+        flat = out.reshape(n_steps * B, -1)
+        return decode_fn(flat) if decode_fn is not None else flat
+
+    # distinct compiled-program name per bucket, so the sanitizer compile
+    # counter can assert "<= one compile per bucket" and the contracts
+    # can key the fingerprint
+    run.__name__ = serve_bucket_name(n_steps, conditional)
+    run.__qualname__ = run.__name__
+    return run
 
 
 class SamplingEngine:
@@ -107,45 +163,10 @@ class SamplingEngine:
         # only ever called with self._lock held (see _program/adopt)
         if key not in self._programs:
             import jax
-            import jax.numpy as jnp
 
-            from fed_tgan_tpu.models.ctgan import generator_apply
-            from fed_tgan_tpu.ops.segments import apply_activate
-
-            spec, cfg, decode_fn = self.spec, self.cfg, self._decode_fn
-            B, emb = cfg.batch_size, cfg.embedding_dim
-
-            def run(params_g, state_g, cond, key, start, pos):
-                # one step == make_sample_step's draw exactly (kz/kc/ka
-                # split order), so the unconditional stream is bit-identical
-                # to SavedSynthesizer.sample_encoded's schedule
-                def single(k):
-                    kz, kc, ka = jax.random.split(k, 3)
-                    z = jax.random.normal(kz, (B, emb))
-                    if spec.n_discrete > 0:
-                        if conditional:
-                            c = jnp.broadcast_to(
-                                (jnp.arange(spec.n_opt) == pos)
-                                .astype(z.dtype)[None, :],
-                                (B, spec.n_opt),
-                            )
-                        else:
-                            c = cond.sample_empirical(kc, B)
-                        z = jnp.concatenate([z, c], axis=1)
-                    raw, _ = generator_apply(params_g, state_g, z, train=False)
-                    return apply_activate(raw, spec, ka)
-
-                def body(carry, i):
-                    return carry, single(jax.random.fold_in(key, start + i))
-
-                _, out = jax.lax.scan(body, None, jnp.arange(n_steps))
-                return decode_fn(out.reshape(n_steps * B, -1))
-
-            # distinct compiled-program name per bucket, so the sanitizer
-            # compile counter can assert "<= one compile per bucket"
-            run.__name__ = (f"serve_bucket_{n_steps}"
-                            f"{'_cond' if conditional else ''}")
-            run.__qualname__ = run.__name__
+            run = build_bucket_program(
+                self.spec, self.cfg, self._decode_fn, n_steps, conditional
+            )
             with self._lock:  # re-entrant: callers already hold it
                 self._programs[key] = jax.jit(run)
         return self._programs[key]

@@ -1,0 +1,24 @@
+"""Stable names for the serve engine's compiled bucket programs.
+
+Three consumers key off these names and must never drift apart:
+
+* the engine itself (``run.__name__`` of each jitted bucket program, so
+  XLA compile logs carry the bucket identity);
+* the runtime sanitizer's serving compile budget
+  (``analysis.sanitizers.check_serving_budget`` counts programs by
+  prefix);
+* the IR program contracts (``analysis.contracts`` keys each lowered
+  bucket fingerprint by this name, so a rename would otherwise read as
+  "entrypoint vanished + new uncontracted entrypoint").
+
+Pure stdlib -- importable from the lint/contract prong without JAX.
+"""
+
+from __future__ import annotations
+
+SERVE_BUCKET_PREFIX = "serve_bucket_"
+
+
+def serve_bucket_name(n_steps: int, conditional: bool) -> str:
+    """Program name for the (power-of-two step bucket, conditional?) pair."""
+    return f"{SERVE_BUCKET_PREFIX}{int(n_steps)}{'_cond' if conditional else ''}"

@@ -323,15 +323,22 @@ class MDGANTrainer(RoundBookkeeping):
             self._sync_or_rollback(gen, _rollback, sample_hook)
             # single-scalar divergence check; full metric arrays cross to
             # host only on the failure path (to name the bad round)
-            if on_nonfinite != "ignore" and not bool(finite):
+            # host metric values are only needed on the failure path or a
+            # log round -- and then via ONE batched device_get (jaxlint J01)
+            bad = on_nonfinite != "ignore" and not bool(finite)
+            log_due = bool(log_every) and e % log_every == 0
+            metrics_host = (jax.device_get(metrics) if bad or log_due
+                            else None)
+            if bad:
                 self._check_finite(
-                    jax.tree.map(lambda x: np.asarray(x)[None], metrics),
+                    jax.tree.map(lambda x: x[None], metrics_host),
                     e, on_nonfinite,
                 )
             self._finish_round(time.time() - t0 - t_pre, e, sample_hook,
                                pre_hook_s=t_pre)
-            if log_every and e % log_every == 0:
-                m = jax.tree.map(lambda x: np.asarray(x).mean(), metrics)
+            if log_due:
+                m = jax.tree.map(lambda x: np.asarray(x).mean(),
+                                 metrics_host)
                 print(
                     f"mdgan round {e}: loss_d={m['loss_d']:.3f} "
                     f"loss_g={m['loss_g']:.3f} ({self.epoch_times[-1]:.3f}s)"

@@ -151,7 +151,8 @@ class SimilarityMonitor:
         out = self._program(trainer)(
             params_g, state_g, trainer.server_cond, jax.random.key(seed + 31)
         )
-        return {k: float(v) for k, v in out.items()}
+        # one batched transfer for both scalars (jaxlint J01)
+        return {k: float(v) for k, v in jax.device_get(out).items()}
 
 
 class MonitorLog:

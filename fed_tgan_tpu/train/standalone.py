@@ -80,7 +80,8 @@ class StandaloneSynthesizer:
                 self.models, self.train_data, self.cond, self.rows, ekey
             )
             if self.verbose:
-                m = jax.tree.map(float, metrics)
+                # one batched transfer per log line (jaxlint J01)
+                m = jax.tree.map(float, jax.device_get(metrics))
                 print(
                     f"epoch {i}: loss_d={m['loss_d']:.3f} pen={m['pen']:.3f} "
                     f"loss_g={m['loss_g']:.3f} ({time.time() - t0:.2f}s)"

@@ -1,0 +1,40 @@
+"""J06 bad twin: strong f64 host scalars / dtype requests inside jit."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def scaled(x):
+    return x * np.float64(2.0)  # EXPECT: J06
+
+
+@jax.jit
+def offset(x):
+    y = x + 1.0  # weak literal: fine on its own
+    return np.double(3.0) + y  # EXPECT: J06
+
+
+@jax.jit
+def shifted(x):
+    return x + np.asarray([1.0, 2.0])  # EXPECT: J06
+
+
+@jax.jit
+def requested(x):
+    acc = jnp.zeros(8, dtype=np.float64)  # EXPECT: J06
+    return acc + x
+
+
+def body(x):
+    return jnp.asarray(x, dtype="float64")  # EXPECT: J06
+
+
+def build():
+    return jax.jit(body)
+
+
+@jax.jit
+def builtin_float(x):
+    idx = jnp.arange(4, dtype=float)  # EXPECT: J06
+    return x[idx.astype(jnp.int32)]

@@ -1,0 +1,34 @@
+"""J06 good twin: weak-typed literals and explicit f32 dtypes inside
+jit; strong f64 stays on the host side -- zero findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: host-side f64 is legitimate (BGM fits, CSV decode tables)
+_HOST_TABLE = np.asarray([1.0, 2.0], dtype=np.float64)
+
+
+@jax.jit
+def scaled(x):
+    return x * 2.0  # weak Python literal inherits x's dtype
+
+
+@jax.jit
+def offset(x):
+    return x + jnp.float32(3.0)
+
+
+@jax.jit
+def shifted(x):
+    return x + np.asarray([1.0, 2.0], dtype=np.float32)
+
+
+@jax.jit
+def requested(x):
+    acc = jnp.zeros(8, dtype=jnp.float32)
+    return acc + x
+
+
+def host_summary(rows):
+    # not jitted: numpy's f64 default is the right tool here
+    return np.asarray(rows, dtype=float).mean()

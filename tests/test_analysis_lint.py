@@ -29,7 +29,8 @@ def _expected(path: Path):
     return out
 
 
-@pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j05"])
+@pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j05",
+                                     "j06"])
 def test_bad_twin_exact_findings(rule_id):
     path = FIXTURES / f"{rule_id}_bad.py"
     expected = _expected(path)
@@ -38,7 +39,8 @@ def test_bad_twin_exact_findings(rule_id):
     assert got == expected
 
 
-@pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j05"])
+@pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j05",
+                                     "j06"])
 def test_good_twin_zero_findings(rule_id):
     path = FIXTURES / f"{rule_id}_good.py"
     findings = run_lint(paths=[path])
@@ -123,7 +125,7 @@ def test_cli_rule_filter():
 
 def test_rule_registry_complete():
     assert {r.rule_id for r in ALL_RULES} == {
-        "J01", "J02", "J03", "J04", "J05"}
+        "J01", "J02", "J03", "J04", "J05", "J06"}
     for rid, rule in RULES_BY_ID.items():
         assert rule.rule_id == rid and rule.hint and rule.title
 

@@ -1,0 +1,302 @@
+"""hlolint: StableHLO fingerprint parsing, the two-sided contract
+ratchet's exit-code policy, synthetic-regression detection on a real
+lowered program, and the repo-wide tier-1 gate."""
+import contextlib
+import json
+
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.analysis.contracts.check import (
+    DRIFT,
+    IMPROVEMENT,
+    REGRESSION,
+    diff_contracts,
+    diff_program,
+    run_contracts,
+)
+from fed_tgan_tpu.analysis.contracts.ir import (
+    Fingerprint,
+    fingerprint_text,
+    tensor_nbytes,
+)
+
+# ------------------------------------------------- handwritten HLO text
+
+#: an all_reduce with a reduction region (the arrow comes AFTER the
+#: region closes) plus a single-line all_gather with an inline arrow.
+_COLLECTIVE_BODY = """\
+    %1 = "stablehlo.all_reduce"(%arg0) ({
+    ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+      %s = stablehlo.add %a, %b : tensor<f32>
+      stablehlo.return %s : tensor<f32>
+    }) {replica_groups = dense<0> : tensor<1x8xi64>} : (tensor<8xf32>) -> tensor<8xf32>
+    %2 = "stablehlo.all_gather"(%1) {all_gather_dim = 0 : i64} : (tensor<8xf32>) -> tensor<64xf32>
+"""
+
+
+def _hlo(body: str = "", args: str = "%arg0: tensor<8xf32>",
+         results: str = "(tensor<8xf32>)") -> str:
+    return (
+        "module @jit_prog {\n"
+        f"  func.func public @main({args}) -> {results} {{\n"
+        f"{body}"
+        "    return %arg0 : tensor<8xf32>\n"
+        "  }\n"
+        "}\n"
+    )
+
+
+def test_tensor_nbytes():
+    assert tensor_nbytes("8", "f32") == 32
+    assert tensor_nbytes("2x3x4", "f64") == 192
+    assert tensor_nbytes("", "i32") == 4  # scalar tensor<i32>
+    assert tensor_nbytes("8", "mystery") == 0  # unknown dtype: census-only
+
+
+def test_fingerprint_collectives_counts_and_bytes():
+    fp = fingerprint_text(_hlo(_COLLECTIVE_BODY))
+    assert fp.collectives["all_reduce"] == {"count": 1, "bytes": 32}
+    assert fp.collectives["all_gather"] == {"count": 1, "bytes": 256}
+
+
+def test_fingerprint_transfer_surface():
+    fp = fingerprint_text(_hlo(
+        args="%arg0: tensor<8xf32>, %arg1: tensor<4x2xi32>",
+        results="(tensor<8xf32>, tensor<i32>)"))
+    assert fp.transfers == {
+        "n_inputs": 2, "in_bytes": 32 + 32,
+        "n_outputs": 2, "out_bytes": 32 + 4,
+        "donated_args": 0,
+    }
+
+
+def test_fingerprint_donation_attr():
+    fp = fingerprint_text(_hlo(
+        args="%arg0: tensor<8xf32> {tf.aliasing_output = 0 : i32}, "
+             "%arg1: tensor<8xf32>"))
+    assert fp.transfers["donated_args"] == 1
+    assert fp.transfers["n_inputs"] == 2
+
+
+def test_fingerprint_dtype_census_and_roundtrip():
+    fp = fingerprint_text(_hlo(_COLLECTIVE_BODY))
+    assert fp.dtypes["f32"] > 0 and "f64" not in fp.dtypes
+    assert Fingerprint.from_dict(fp.to_dict()).to_dict() == fp.to_dict()
+
+
+def test_donation_detected_in_real_lowering():
+    jax = pytest.importorskip("jax")
+    text = jax.jit(lambda x: x + 1.0, donate_argnums=0).lower(
+        np.zeros(4, np.float32)).as_text()
+    assert fingerprint_text(text).transfers["donated_args"] == 1
+
+
+# -------------------------------------------------- diff-policy semantics
+
+def _fp(**kw):
+    base = dict(collectives={}, transfers={
+        "n_inputs": 1, "in_bytes": 32, "n_outputs": 1, "out_bytes": 32,
+        "donated_args": 1}, dtypes={"f32": 3})
+    base.update(kw)
+    return Fingerprint(**base)
+
+
+def test_diff_program_two_sided():
+    stored = _fp(collectives={"all_gather": {"count": 1, "bytes": 256}}
+                 ).to_dict()
+    worse = _fp(collectives={"all_gather": {"count": 2, "bytes": 512}})
+    sev = {i.metric: i.severity
+           for i in diff_program("f", "p", stored, worse)}
+    assert sev == {"collectives.all_gather.count": REGRESSION,
+                   "collectives.all_gather.bytes": REGRESSION}
+    better = _fp()  # collective gone entirely
+    assert {i.severity for i in diff_program("f", "p", stored, better)} \
+        == {IMPROVEMENT}
+    # losing donation is a regression; f64 growth is forbidden; a benign
+    # census move is informational drift
+    hazy = _fp(transfers={**_fp().transfers, "donated_args": 0},
+               dtypes={"f32": 3, "f64": 2, "bf16": 1})
+    sev = {i.metric: i.severity
+           for i in diff_program("f", "p", _fp().to_dict(), hazy)}
+    assert sev["transfers.donated_args"] == REGRESSION
+    assert sev["dtypes.f64"] == REGRESSION
+    assert sev["dtypes.bf16"] == DRIFT
+
+
+def test_diff_contracts_membership():
+    cur = {"fam": {"a": _fp(), "b": _fp()}}
+    # missing family file
+    issues = diff_contracts(cur, {"fam": None})
+    assert [i.severity for i in issues] == [REGRESSION]
+    assert "no contract file" in issues[0].message
+    # recorded program vanished + new program unrecorded
+    stored = {"fam": {"programs": {"a": _fp().to_dict(),
+                                   "gone": _fp().to_dict()}}}
+    by_prog = {i.program: i for i in diff_contracts(cur, stored)}
+    assert by_prog["gone"].severity == REGRESSION
+    assert by_prog["b"].severity == REGRESSION
+    assert "new entrypoint" in by_prog["b"].message
+
+
+# ---------------------------------------------- CLI policy (exit codes)
+
+_BASE = _hlo(_COLLECTIVE_BODY)
+#: one extra all_gather op == the synthetic collective regression.
+_WORSE = _hlo(_COLLECTIVE_BODY + (
+    '    %3 = "stablehlo.all_gather"(%1) {all_gather_dim = 0 : i64} : '
+    "(tensor<8xf32>) -> tensor<64xf32>\n"))
+_BETTER = _hlo()
+
+
+def _run(tmp_path, text, lines, *, family="parallel_fedavg", **kw):
+    return run_contracts(contracts_dir=tmp_path,
+                         entrypoints={family: {"toy": lambda: text}},
+                         out=lines.append, **kw)
+
+
+def test_cli_update_then_clean(tmp_path):
+    lines = []
+    assert _run(tmp_path, _BASE, lines, update=True) == 0
+    assert (tmp_path / "parallel_fedavg.json").exists()
+    assert _run(tmp_path, _BASE, lines) == 0
+    assert "0 regression(s)" in lines[-1]
+
+
+def test_cli_regression_exits_1_with_explain(tmp_path):
+    lines = []
+    assert _run(tmp_path, _BASE, lines, update=True) == 0
+    assert _run(tmp_path, _WORSE, lines, explain=True) == 1
+    text = "\n".join(lines)
+    assert "collectives.all_gather.count 1 -> 2" in text
+    assert "+1 all_gather op(s)" in text
+    # --explain greps the family's subsystem for candidate source sites
+    assert "candidate source sites" in text
+    assert "fed_tgan_tpu/parallel/" in text
+
+
+def test_cli_improvement_exits_0_with_stale_warning(tmp_path):
+    lines = []
+    assert _run(tmp_path, _BASE, lines, update=True) == 0
+    assert _run(tmp_path, _BETTER, lines) == 0
+    assert any("stale contract" in ln for ln in lines)
+
+
+def test_cli_missing_contract_exits_1(tmp_path):
+    lines = []
+    assert _run(tmp_path, _BASE, lines) == 1
+    assert any("no contract file" in ln for ln in lines)
+
+
+def test_cli_new_entrypoint_exits_1(tmp_path):
+    lines = []
+    assert _run(tmp_path, _BASE, lines, update=True) == 0
+    rc = run_contracts(
+        contracts_dir=tmp_path,
+        entrypoints={"parallel_fedavg": {"toy": lambda: _BASE,
+                                         "fresh": lambda: _BASE}},
+        out=lines.append)
+    assert rc == 1
+    assert any("new entrypoint" in ln for ln in lines)
+
+
+def test_cli_bad_contract_exits_2(tmp_path):
+    (tmp_path / "parallel_fedavg.json").write_text("{not json")
+    lines = []
+    assert _run(tmp_path, _BASE, lines) == 2
+    assert any("bad contract" in ln for ln in lines)
+
+
+def test_cli_json_format(tmp_path):
+    lines = []
+    assert _run(tmp_path, _BASE, lines, update=True) == 0
+    assert _run(tmp_path, _WORSE, lines, fmt="json") == 1
+    payload = json.loads(lines[-1])
+    assert payload["regressions"] == 2  # count + bytes
+    assert payload["families"] == {"parallel_fedavg": ["toy"]}
+    metrics = {i["metric"] for i in payload["issues"]}
+    assert "collectives.all_gather.count" in metrics
+
+
+# --------------------------- synthetic regression on a REAL lowering
+
+def _require_mesh_or_skip():
+    from fed_tgan_tpu.analysis.contracts.harness import (
+        HarnessError,
+        require_mesh,
+    )
+    try:
+        require_mesh()
+    except HarnessError as exc:
+        pytest.skip(f"lowering unavailable: {exc}")
+
+
+@pytest.mark.contracts
+def test_synthetic_regression_in_lowered_program(tmp_path):
+    """The acceptance scenario: a test-only shard_map program grows an
+    extra all_gather and an f64 upcast; the CLI must exit 1 and name the
+    op delta in --explain output."""
+    jax = pytest.importorskip("jax")
+    _require_mesh_or_skip()
+    import jax.numpy as jnp
+
+    from fed_tgan_tpu.parallel.mesh import (
+        CLIENTS_AXIS,
+        client_mesh,
+        shard_map,
+    )
+
+    mesh = client_mesh(8)
+
+    def lower(fn, x64=False):
+        sm = shard_map(fn, mesh=mesh, in_specs=(
+            jax.sharding.PartitionSpec(CLIENTS_AXIS),),
+            out_specs=jax.sharding.PartitionSpec(), check_vma=False)
+        ctx = (jax.experimental.enable_x64() if x64
+               else contextlib.nullcontext())
+        with ctx:
+            return jax.jit(sm).lower(
+                np.zeros((8, 4), np.float32)).as_text()
+
+    def base(x):
+        return jax.lax.psum(x, CLIENTS_AXIS)
+
+    def worse(x):
+        extra = jax.lax.all_gather(x, CLIENTS_AXIS)  # injected collective
+        upcast = x.astype(jnp.float64).sum()         # injected f64
+        return (jax.lax.psum(x, CLIENTS_AXIS)
+                + extra.sum() + upcast.astype(x.dtype))
+
+    base_text, worse_text = lower(base), lower(worse, x64=True)
+
+    lines = []
+    entry = {"synthetic": {"prog": lambda: base_text}}
+    assert run_contracts(update=True, contracts_dir=tmp_path,
+                         entrypoints=entry, out=lines.append) == 0
+    entry = {"synthetic": {"prog": lambda: worse_text}}
+    rc = run_contracts(contracts_dir=tmp_path, entrypoints=entry,
+                       explain=True, out=lines.append)
+    assert rc == 1
+    text = "\n".join(lines)
+    assert "collectives.all_gather.count 0 -> 1" in text
+    assert "dtypes.f64" in text and "forbidden" in text
+    # pristine program still passes
+    entry = {"synthetic": {"prog": lambda: base_text}}
+    assert run_contracts(contracts_dir=tmp_path, entrypoints=entry,
+                         out=lines.append) == 0
+
+
+# ------------------------------------------------- repo-wide tier-1 gate
+
+@pytest.mark.contracts
+def test_repo_contracts_gate():
+    """Tier-1 gate: every contracted entrypoint, lowered fresh, must
+    match the checked-in fingerprints (improvements included -- a stale
+    contract warns but passes)."""
+    pytest.importorskip("jax")
+    _require_mesh_or_skip()
+    lines = []
+    rc = run_contracts(out=lines.append)
+    if rc == 2:
+        pytest.skip("lowering unavailable: " + "\n".join(lines))
+    assert rc == 0, "\n".join(lines)

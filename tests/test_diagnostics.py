@@ -82,7 +82,7 @@ def test_doctor_cli_all_green_on_cpu(tmp_path):
     assert "FAIL" not in proc.stdout
     for name in ("runtime", "backend", "virtual-mesh", "transport",
                  "robust-agg", "compile-cache", "static-analysis",
-                 "serving"):
+                 "program-contracts", "serving"):
         assert f"OK   {name}" in proc.stdout, proc.stdout
 
 
