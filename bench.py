@@ -360,7 +360,8 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
 
 
 def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
-                profile_dir: str | None = None) -> dict:
+                profile_dir: str | None = None,
+                obs_dir: str | None = "bench_obs_round") -> dict:
     """Seconds per round of the real server loop: every round runs the
     clients' local steps + weighted FedAvg and snapshots 40k rows to a CSV,
     exactly like the reference server (distributed.py:785-829).  The
@@ -371,40 +372,77 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
     ``profile_dir`` wraps the measured rounds in a ``jax.profiler`` trace —
     the tool for attributing the round's wall-clock between device compute
     and the snapshot D2H transfer (warmup stays outside the trace).
+
+    ``obs_dir`` (on by default; pass ``--obs-dir ""`` to disable) installs
+    the telemetry layer for the run and writes three artifacts there:
+    ``journal.jsonl`` (the run journal: round/aggregate/compile events),
+    ``trace.json`` (host-side spans, Chrome trace-event format — load in
+    Perfetto, alongside the device trace if ``profile_dir`` is also set),
+    and ``metrics.prom`` (the process-wide registry in Prometheus text).
+    The host-phase attribution table from the spans rides along in the
+    returned dict — this subsumes scripts/trace_attribution.py's
+    collection side for the host half of the story.
     """
     import contextlib
     import tempfile
 
+    from fed_tgan_tpu.obs import (RunJournal, get_registry, set_journal,
+                                  start_tracing, stop_tracing)
     from fed_tgan_tpu.train.snapshots import SnapshotWriter
 
-    _, init, trainer = _setup(bgm_backend=bgm_backend)
-    with tempfile.TemporaryDirectory() as td:
-        writer = SnapshotWriter(
-            init.global_meta, init.encoders,
-            lambda e: os.path.join(td, f"snapshot_{e}.csv"),
-        )
-        if profile_dir is not None:
-            from fed_tgan_tpu.runtime.profiling import device_trace
+    journal = tracer = None
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        journal = RunJournal(os.path.join(obs_dir, "journal.jsonl"),
+                             run_id="bench_round")
+        set_journal(journal)
+        tracer = start_tracing()
+    try:
+        _, init, trainer = _setup(bgm_backend=bgm_backend)
+        with tempfile.TemporaryDirectory() as td:
+            writer = SnapshotWriter(
+                init.global_meta, init.encoders,
+                lambda e: os.path.join(td, f"snapshot_{e}.csv"),
+            )
+            if profile_dir is not None:
+                from fed_tgan_tpu.runtime.profiling import device_trace
 
-            trace = device_trace(profile_dir)
-        else:
-            trace = contextlib.nullcontext()
-        with writer:
-            # warmup: compiles the rounds=1 epoch program + sample/decode
-            # programs and touches the whole transfer/decode/write path
-            trainer.fit(2, sample_hook=writer)
-            writer.drain()
-            with trace:
-                t0 = time.time()
-                trainer.fit(rounds, sample_hook=writer)
+                trace = device_trace(profile_dir)
+            else:
+                trace = contextlib.nullcontext()
+            with writer:
+                # warmup: compiles the rounds=1 epoch program + sample/decode
+                # programs and touches the whole transfer/decode/write path
+                trainer.fit(2, sample_hook=writer)
                 writer.drain()
-                value = (time.time() - t0) / rounds
-    return {
-        "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)",
-        "value": round(value, 4),
-        "unit": "s/round",
-        "vs_baseline": round(BASELINE_EPOCH_SECONDS / value, 2),
-    }
+                with trace:
+                    t0 = time.time()
+                    trainer.fit(rounds, sample_hook=writer)
+                    writer.drain()
+                    value = (time.time() - t0) / rounds
+        result = {
+            "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)",
+            "value": round(value, 4),
+            "unit": "s/round",
+            "vs_baseline": round(BASELINE_EPOCH_SECONDS / value, 2),
+        }
+        if obs_dir:
+            trace_path = tracer.export(os.path.join(obs_dir, "trace.json"))
+            metrics_path = os.path.join(obs_dir, "metrics.prom")
+            with open(metrics_path, "w") as f:
+                f.write(get_registry().render_prometheus())
+            result["obs"] = {
+                "journal": journal.path,
+                "trace": trace_path,
+                "metrics": metrics_path,
+                "host_phases": tracer.phase_summary(),
+            }
+        return result
+    finally:
+        if obs_dir:
+            set_journal(None)
+            journal.close()
+            stop_tracing()
 
 
 def bench_full500(
@@ -1257,6 +1295,14 @@ def main() -> int:
     ap.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                     help="round workload: capture a jax.profiler trace of "
                          "the measured rounds into DIR")
+    ap.add_argument("--obs-dir", type=str, default="bench_obs_round",
+                    metavar="DIR",
+                    help="round workload: write telemetry artifacts into "
+                         "DIR — journal.jsonl (run journal), trace.json "
+                         "(host spans, Chrome trace-event format for "
+                         "Perfetto), metrics.prom (metrics registry, "
+                         "Prometheus text).  Pass an empty string to "
+                         "disable")
     ap.add_argument("--backend", choices=["cpu"], default=None,
                     help="cpu = run this bench explicitly on the cpu "
                          "platform with no accelerator probe (for "
@@ -1443,7 +1489,8 @@ def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
         return bench_serving(clients=clients)
     if args.workload == "round":
         return bench_round(bgm_backend=bgm,
-                           profile_dir=args.profile_dir)
+                           profile_dir=args.profile_dir,
+                           obs_dir=args.obs_dir or None)
     if args.workload == "utility":
         return bench_utility(
             epochs, n_clients=clients, weighted=not args.uniform,

@@ -27,6 +27,8 @@ import re
 import threading
 from typing import Dict, List, Optional
 
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+
 __all__ = [
     "CompileCounter",
     "compile_report",
@@ -69,6 +71,7 @@ class CompileCounter(logging.Handler):
             # logging.Handler.handle() already serialises emit() calls
             # under the handler's own lock
             self.events.append(m.group(1))  # jaxlint: disable=J05
+            _emit_event("compile", program=m.group(1))
 
     # ----------------------------------------------------------- queries
 

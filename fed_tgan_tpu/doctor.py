@@ -403,6 +403,75 @@ def wait_healthy(timeout_min: float = 0.0, quiet_min: float = 45.0,
     return False
 
 
+def check_observability() -> bool:
+    """The obs layer is importable pre-jax, durable, and self-describing.
+
+    Three properties, each in the cheapest form that still proves it:
+    importing ``fed_tgan_tpu.obs`` in a fresh interpreter must not drag in
+    jax (the registry/journal are crash-path tools — they have to work
+    when jax itself is the thing that is broken); the JSONL journal must
+    round-trip an event through the real file path; and the ``obs report``
+    CLI must summarize a synthetic journal from a fresh process."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_doctor_obs_")
+    try:
+        # 1. pre-jax import.  Compare the sys.modules DELTA instead of
+        # asserting absence: on site-hooked hosts jax is already imported
+        # at interpreter startup, and that must not fail this check.
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; had = 'jax' in sys.modules; "
+             "import fed_tgan_tpu.obs; "
+             "assert ('jax' in sys.modules) == had, 'obs import pulled jax'; "
+             "print('ok')"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0 or "ok" not in proc.stdout:
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            return _line(False, "observability",
+                         "obs import check failed: "
+                         + (" | ".join(tail) or f"rc={proc.returncode}"))
+
+        # 2. journal round-trip through the real append/flush path.
+        from fed_tgan_tpu.obs.journal import RunJournal, read_journal
+
+        jpath = os.path.join(tmp, "journal.jsonl")
+        with RunJournal(jpath, run_id="doctor") as j:
+            j.emit("round", first=0, last=0, rounds=1, per_round_s=0.01)
+        events = list(read_journal(jpath))
+        types = [e.get("type") for e in events]
+        if types != ["run_start", "round", "run_end"]:
+            return _line(False, "observability",
+                         f"journal round-trip produced {types}")
+
+        # 3. the report CLI, from a fresh process, on that same journal.
+        proc = subprocess.run(
+            [sys.executable, "-m", "fed_tgan_tpu.obs", "report", jpath,
+             "--format", "json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            return _line(False, "observability",
+                         "report CLI failed: "
+                         + (" | ".join(tail) or f"rc={proc.returncode}"))
+        summary = json.loads(proc.stdout)
+        if summary.get("events") != 3 or summary.get("run_id") != "doctor":
+            return _line(False, "observability",
+                         f"report CLI summary wrong: {summary}")
+        return _line(True, "observability",
+                     "obs imports without jax; journal round-trips; "
+                     "report CLI summarized 3 events")
+    except Exception as exc:
+        return _line(False, "observability", f"{exc!r}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -450,6 +519,7 @@ def main(argv=None) -> int:
         check_compile_cache(),
         check_static_analysis(),
         check_program_contracts(),
+        check_observability(),
         check_serving(),
     ]
     bad = checks.count(False)

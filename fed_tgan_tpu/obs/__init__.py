@@ -1,0 +1,67 @@
+"""Unified telemetry: metrics registry, span tracing, run journal.
+
+Three legs, one package, zero heavy imports:
+
+- :mod:`.registry` -- a process-wide, thread-safe metrics registry
+  (counters / gauges / histograms) with Prometheus text exposition.
+  ``serve.metrics.ServiceMetrics`` is re-implemented on top of it; the
+  trainer, transport, checkpointing, and watchdog publish into the
+  process-wide default registry.
+- :mod:`.trace` -- ``span(name, **attrs)`` host-side span tracing with a
+  Chrome-trace / Perfetto JSON exporter, so host phases (ingest,
+  local-steps, aggregate, snapshot, monitor, checkpoint) can be overlaid
+  on the XLA device timeline from ``runtime/profiling.py``.
+- :mod:`.journal` -- a durable per-run JSONL event stream (round
+  summaries, watchdog alarms and rollbacks, quarantine / eviction,
+  transport reconnects and heartbeat lapses, compile events, backend
+  probes, checkpoints) with a stable schema, summarized by
+  ``python -m fed_tgan_tpu.obs report <journal>``.
+
+Everything here is pure stdlib and MUST stay importable before
+jax / numpy warm up -- ``doctor.py --check observability`` enforces it.
+Instrumentation is free by construction: ``span`` and ``emit`` touch
+only host clocks and Python objects (never device arrays), so hot
+regions stay clean under ``jax.transfer_guard_device_to_host``.
+"""
+
+from __future__ import annotations
+
+from fed_tgan_tpu.obs.journal import (
+    RunJournal,
+    emit,
+    get_journal,
+    read_journal,
+    set_journal,
+)
+from fed_tgan_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from fed_tgan_tpu.obs.trace import (
+    Tracer,
+    current_tracer,
+    span,
+    start_tracing,
+    stop_tracing,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RunJournal",
+    "Tracer",
+    "current_tracer",
+    "emit",
+    "get_journal",
+    "get_registry",
+    "read_journal",
+    "set_journal",
+    "span",
+    "start_tracing",
+    "stop_tracing",
+]

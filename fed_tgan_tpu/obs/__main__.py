@@ -1,0 +1,40 @@
+"""``python -m fed_tgan_tpu.obs`` -- observability CLI.
+
+Subcommands:
+
+- ``report <journal.jsonl> [--format text|json]`` -- summarize a run
+  journal (rounds, watchdog, robustness, transport, compiles,
+  checkpoints).
+
+Exit codes: 0 ok, 2 usage / unreadable journal.  Pure stdlib -- never
+imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fed_tgan_tpu.obs.report import report_main
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m fed_tgan_tpu.obs",
+        description="run-journal tooling for fed_tgan_tpu telemetry",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="summarize a run journal (JSONL)")
+    rep.add_argument("journal", help="path to the journal JSONL file")
+    rep.add_argument("--format", choices=("text", "json"), default="text")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "report":
+        return report_main(args.journal, fmt=args.format)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,173 @@
+"""Durable run journal: one JSONL event stream per run.
+
+Every line is one event::
+
+    {"ts": <unix seconds>, "type": "<event type>", ...fields}
+
+The first line of a journal is always a ``run_start`` event carrying
+``schema`` (the journal schema version) and ``run_id``.  Consumers --
+``python -m fed_tgan_tpu.obs report``, soak analysis, dashboards --
+key off ``type``; unknown types must be ignored, unknown fields
+preserved (the schema is append-only: fields are added, never renamed).
+
+Event catalogue (``EVENT_TYPES``):
+
+========================  ====================================================
+type                      emitted by / meaning
+========================  ====================================================
+run_start / run_end       journal lifecycle (run_end carries ``seconds``)
+round                     trainer round-chunk summary (first/last/seconds/...)
+aggregate                 aggregation summary for a chunk (aggregator, clients)
+quarantine                in-round update screen quarantined a client
+client_dropped            dead/evicted client removed from federation
+watchdog_alarm            training-health watchdog tripped
+watchdog_rollback         watchdog restored params from a checkpoint
+checkpoint                crash-safe checkpoint published
+checkpoint_restore        checkpoint loaded for resume/rollback
+transport_reconnect       transport peer re-established after a drop
+transport_drop            server marked a peer dead
+heartbeat_lapse           liveness deadline exceeded for a peer
+compile                   XLA compile event (from the sanitizer counter)
+backend_probe             subprocess backend-responsiveness probe outcome
+device_trace              runtime/profiling device trace start/stop/failure
+serve_reload              serving hot-reloaded a model artifact
+========================  ====================================================
+
+Writers go through a process-wide current journal: ``set_journal``
+installs one, module-level :func:`emit` is a cheap no-op while none is
+installed, so library code can emit unconditionally.  ``RunJournal``
+itself is thread-safe and flushes every line (durability over
+throughput -- journals are low-rate by design; the hot path emits at
+round granularity, never per-step).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, List, Optional
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EVENT_TYPES",
+    "RunJournal",
+    "emit",
+    "get_journal",
+    "read_journal",
+    "set_journal",
+]
+
+SCHEMA_VERSION = 1
+
+EVENT_TYPES = frozenset({
+    "run_start", "run_end",
+    "round", "aggregate",
+    "quarantine", "client_dropped",
+    "watchdog_alarm", "watchdog_rollback",
+    "checkpoint", "checkpoint_restore",
+    "transport_reconnect", "transport_drop", "heartbeat_lapse",
+    "compile", "backend_probe", "device_trace", "serve_reload",
+})
+
+
+class RunJournal:
+    """Append-only JSONL event writer for one run.
+
+    ``emit()`` never raises into the instrumented caller: a journal
+    that loses its disk must not take the training run down with it.
+    """
+
+    def __init__(self, path: str, run_id: Optional[str] = None) -> None:
+        self.path = str(path)
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        directory = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "a", buffering=1)
+        self._t0 = time.time()
+        self._closed = False
+        self.emit("run_start", schema=SCHEMA_VERSION, run_id=self.run_id,
+                  pid=os.getpid())
+
+    def emit(self, type: str, **fields) -> Optional[dict]:
+        """Append one event; returns the event dict (None if closed)."""
+        event: Dict[str, object] = {"ts": round(time.time(), 6),
+                                    "type": str(type)}
+        event.update(fields)
+        try:
+            line = json.dumps(event, default=str)
+        except (TypeError, ValueError):
+            event = {"ts": event["ts"], "type": event["type"],
+                     "error": "unserializable fields dropped"}
+            line = json.dumps(event)
+        with self._lock:
+            if self._closed:
+                return None
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except OSError:
+                return None
+        return event
+
+    def close(self) -> None:
+        self.emit("run_end", seconds=round(time.time() - self._t0, 3))
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+
+    def __enter__(self) -> "RunJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_INSTALL_LOCK = threading.Lock()
+_JOURNAL: Optional[RunJournal] = None
+
+
+def set_journal(journal: Optional[RunJournal]) -> Optional[RunJournal]:
+    """Install ``journal`` as the process journal; returns the previous."""
+    global _JOURNAL
+    with _INSTALL_LOCK:
+        prev, _JOURNAL = _JOURNAL, journal
+        return prev
+
+
+def get_journal() -> Optional[RunJournal]:
+    return _JOURNAL
+
+
+def emit(type: str, **fields) -> Optional[dict]:
+    """Emit into the process journal; free no-op while none installed."""
+    j = _JOURNAL
+    if j is None:
+        return None
+    return j.emit(type, **fields)
+
+
+def read_journal(path: str) -> Iterator[dict]:
+    """Yield parsed events; tolerates blank and truncated tail lines."""
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write on crash -- skip, don't die
+            if isinstance(event, dict):
+                yield event
+
+
+def load_journal(path: str) -> List[dict]:
+    return list(read_journal(path))

@@ -1,0 +1,260 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Pure stdlib, thread-safe, importable before jax/numpy warm-up.  One
+process-wide default registry (:func:`get_registry`) collects metrics
+from every subsystem -- training, transport, checkpointing, watchdog,
+serving -- and renders them in Prometheus text exposition format.
+Isolated :class:`MetricsRegistry` instances exist for tests and for
+per-service scoping (``serve.metrics.ServiceMetrics`` holds its own).
+
+Metric names follow Prometheus conventions: ``<subsystem>_<what>_total``
+for counters, bare gauges for instantaneous values.  ``counter()`` /
+``gauge()`` / ``histogram()`` are get-or-create: the same name always
+returns the same object, and a name collision across metric kinds
+raises ``TypeError`` rather than silently aliasing.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_BUCKETS",
+    "get_registry",
+    "counter",
+    "gauge",
+    "histogram",
+]
+
+#: default histogram bucket upper bounds (seconds-flavoured, like
+#: Prometheus client defaults) -- override per-histogram for other units
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Counter:
+    """Monotonically increasing value.  ``inc(amount)`` with amount >= 0."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative inc {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> float:
+        return self.value
+
+
+class Gauge:
+    """Instantaneous value: ``set`` / ``inc`` / ``dec``."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> float:
+        return self.value
+
+
+class Histogram:
+    """Distribution: Prometheus cumulative buckets + an exact-quantile
+    reservoir.
+
+    The bucket counts / sum / count follow the Prometheus histogram
+    exposition; the bounded ``reservoir`` (most recent N observations)
+    additionally gives exact ``quantile()`` answers over the recent
+    window -- the serving p50/p99 contract predates this registry and
+    is preserved by it.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Iterable[float] = DEFAULT_BUCKETS,
+                 reservoir: int = 4096) -> None:
+        self.name = name
+        self.help = help
+        self.buckets: Tuple[float, ...] = tuple(sorted(set(buckets)))
+        self._lock = threading.Lock()
+        self._bucket_counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._count = 0
+        self._sum = 0.0
+        self._reservoir: deque = deque(maxlen=max(1, int(reservoir)))
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._bucket_counts[idx] += 1
+            self._count += 1
+            self._sum += value
+            self._reservoir.append(value)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def reservoir_values(self) -> List[float]:
+        """Sorted copy of the recent-observation reservoir."""
+        with self._lock:
+            return sorted(self._reservoir)
+
+    def quantile(self, q: float) -> float:
+        """Exact nearest-rank quantile over the reservoir window."""
+        lat = self.reservoir_values()
+        if not lat:
+            return 0.0
+        idx = min(len(lat) - 1, max(0, round(q * (len(lat) - 1))))
+        return lat[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            counts = list(self._bucket_counts)
+            total, s = self._count, self._sum
+        out: Dict[str, float] = {"count": total, "sum": s}
+        cum = 0
+        for le, n in zip(self.buckets, counts):
+            cum += n
+            out[f"le_{le:g}"] = cum
+        return out
+
+
+_METRIC_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Thread-safe, get-or-create registry of named metrics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, requested {cls.kind}")
+                return existing
+            metric = cls(name, help=help, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = DEFAULT_BUCKETS,
+                  reservoir: int = 4096) -> Histogram:
+        return self._get_or_create(Histogram, name, help,
+                                   buckets=buckets, reservoir=reservoir)
+
+    def get(self, name: str) -> Optional[object]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self) -> Dict[str, object]:
+        """``{name: value-or-dict}`` for every registered metric."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.snapshot() for m in metrics}
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition of every registered metric."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: List[str] = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            if isinstance(m, Histogram):
+                snap = m.snapshot()
+                cum = 0.0
+                for le in m.buckets:
+                    cum = snap[f"le_{le:g}"]
+                    lines.append(f'{m.name}_bucket{{le="{le:g}"}} {cum:g}')
+                lines.append(f'{m.name}_bucket{{le="+Inf"}} '
+                             f'{snap["count"]:g}')
+                lines.append(f"{m.name}_sum {snap['sum']:g}")
+                lines.append(f"{m.name}_count {snap['count']:g}")
+            else:
+                lines.append(f"{m.name} {m.snapshot():g}")
+        return "\n".join(lines) + "\n"
+
+
+#: the process-wide default registry every subsystem publishes into
+_DEFAULT = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _DEFAULT
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _DEFAULT.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _DEFAULT.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", **kwargs) -> Histogram:
+    return _DEFAULT.histogram(name, help, **kwargs)

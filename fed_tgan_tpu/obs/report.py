@@ -1,0 +1,163 @@
+"""Run-journal summarizer behind ``python -m fed_tgan_tpu.obs report``.
+
+Turns one JSONL journal into the questions an operator actually asks
+after a run: how many rounds and how fast, did the watchdog fire, who
+got quarantined or dropped, did transport flap, what compiled, where
+are the checkpoints.  Text by default, ``--format json`` for tooling
+(doctor round-trips a synthetic journal through the JSON path).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from fed_tgan_tpu.obs.journal import read_journal
+
+__all__ = ["summarize", "render_text"]
+
+
+def summarize(path: str) -> dict:
+    """Structured summary of one journal file."""
+    events = list(read_journal(path))
+    by_type: Dict[str, int] = {}
+    for ev in events:
+        t = str(ev.get("type", "?"))
+        by_type[t] = by_type.get(t, 0) + 1
+
+    out: dict = {
+        "path": str(path),
+        "events": len(events),
+        "by_type": dict(sorted(by_type.items())),
+        "schema": None,
+        "run_id": None,
+        "duration_s": None,
+    }
+    if events:
+        first = next((e for e in events if e.get("type") == "run_start"),
+                     None)
+        if first is not None:
+            out["schema"] = first.get("schema")
+            out["run_id"] = first.get("run_id")
+        ts = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
+        if ts:
+            out["duration_s"] = round(max(ts) - min(ts), 3)
+
+    rounds = [e for e in events if e.get("type") == "round"]
+    if rounds:
+        per = [e["per_round_s"] for e in rounds
+               if isinstance(e.get("per_round_s"), (int, float))]
+        out["rounds"] = {
+            "chunks": len(rounds),
+            "total_rounds": sum(int(e.get("rounds", 1)) for e in rounds),
+            "per_round_s_mean": round(sum(per) / len(per), 4) if per else None,
+            "per_round_s_max": round(max(per), 4) if per else None,
+        }
+
+    alarms = [e for e in events if e.get("type") == "watchdog_alarm"]
+    rollbacks = [e for e in events if e.get("type") == "watchdog_rollback"]
+    if alarms or rollbacks:
+        out["watchdog"] = {
+            "alarms": len(alarms),
+            "rollbacks": len(rollbacks),
+            "reasons": sorted({str(e.get("reason", "?")) for e in alarms}),
+        }
+
+    quarantines = [e for e in events if e.get("type") == "quarantine"]
+    drops = [e for e in events if e.get("type") == "client_dropped"]
+    if quarantines or drops:
+        out["robustness"] = {
+            "quarantine_events": len(quarantines),
+            "clients_dropped": sorted({e.get("client") for e in drops
+                                       if e.get("client") is not None}),
+        }
+
+    flaps = [e for e in events
+             if e.get("type") in ("transport_reconnect", "transport_drop",
+                                  "heartbeat_lapse")]
+    if flaps:
+        out["transport"] = {
+            "reconnects": by_type.get("transport_reconnect", 0),
+            "drops": by_type.get("transport_drop", 0),
+            "heartbeat_lapses": by_type.get("heartbeat_lapse", 0),
+        }
+
+    compiles = [e for e in events if e.get("type") == "compile"]
+    if compiles:
+        per_prog: Dict[str, int] = {}
+        for e in compiles:
+            p = str(e.get("program", "?"))
+            per_prog[p] = per_prog.get(p, 0) + 1
+        out["compiles"] = dict(sorted(per_prog.items()))
+
+    ckpts = [e for e in events if e.get("type") == "checkpoint"]
+    if ckpts:
+        out["checkpoints"] = {
+            "saved": len(ckpts),
+            "last_path": ckpts[-1].get("path"),
+            "restores": by_type.get("checkpoint_restore", 0),
+        }
+
+    probes = [e for e in events if e.get("type") == "backend_probe"]
+    if probes:
+        out["backend_probes"] = {
+            "total": len(probes),
+            "failed": sum(1 for e in probes if not e.get("ok", False)),
+        }
+    return out
+
+
+def render_text(summary: dict) -> str:
+    lines: List[str] = [
+        f"journal: {summary['path']}",
+        f"  run_id={summary.get('run_id')} schema={summary.get('schema')} "
+        f"events={summary['events']} duration_s={summary.get('duration_s')}",
+        "  events by type:",
+    ]
+    for t, n in summary.get("by_type", {}).items():
+        lines.append(f"    {n:6d}  {t}")
+    r = summary.get("rounds")
+    if r:
+        lines.append(f"  rounds: {r['total_rounds']} in {r['chunks']} "
+                     f"chunk(s), per-round mean {r['per_round_s_mean']}s "
+                     f"max {r['per_round_s_max']}s")
+    w = summary.get("watchdog")
+    if w:
+        lines.append(f"  watchdog: {w['alarms']} alarm(s), "
+                     f"{w['rollbacks']} rollback(s) "
+                     f"reasons={w['reasons']}")
+    rb = summary.get("robustness")
+    if rb:
+        lines.append(f"  robustness: {rb['quarantine_events']} quarantine "
+                     f"event(s), dropped clients {rb['clients_dropped']}")
+    tr = summary.get("transport")
+    if tr:
+        lines.append(f"  transport: {tr['reconnects']} reconnect(s), "
+                     f"{tr['drops']} drop(s), "
+                     f"{tr['heartbeat_lapses']} heartbeat lapse(s)")
+    c = summary.get("compiles")
+    if c:
+        lines.append(f"  compiles: {sum(c.values())} event(s) across "
+                     f"{len(c)} program(s)")
+    ck = summary.get("checkpoints")
+    if ck:
+        lines.append(f"  checkpoints: {ck['saved']} saved, "
+                     f"{ck['restores']} restore(s), last {ck['last_path']}")
+    bp = summary.get("backend_probes")
+    if bp:
+        lines.append(f"  backend probes: {bp['total']} "
+                     f"({bp['failed']} failed)")
+    return "\n".join(lines)
+
+
+def report_main(path: str, fmt: str = "text") -> int:
+    try:
+        summary = summarize(path)
+    except OSError as exc:
+        print(f"obs report: cannot read {path}: {exc}")
+        return 2
+    if fmt == "json":
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(render_text(summary))
+    return 0

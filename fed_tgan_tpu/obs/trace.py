@@ -1,0 +1,170 @@
+"""Host-side span tracing with Chrome-trace / Perfetto JSON export.
+
+``span(name, **attrs)`` is the one instrumentation point: a context
+manager that records a complete ("X") event -- name, start, duration,
+thread, nesting depth -- into the installed :class:`Tracer`.  With no
+tracer installed it is a near-free no-op (one global read), so
+instrumented code pays nothing outside profiled runs.
+
+Spans are provably free on the device hot path: they touch only
+``time.perf_counter`` and Python objects, never device arrays, so they
+compose with ``analysis.sanitizers.hot_region`` (no device->host sync
+is ever introduced by tracing).
+
+The export format is the Chrome trace-event JSON flavour that Perfetto
+and ``chrome://tracing`` load directly -- the same family as the XLA
+device trace from ``runtime/profiling.py::device_trace``, so host
+phase spans and the device timeline can be overlaid in one UI.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "Tracer",
+    "current_tracer",
+    "span",
+    "start_tracing",
+    "stop_tracing",
+]
+
+
+class Tracer:
+    """Bounded in-memory trace-event collector."""
+
+    def __init__(self, max_events: int = 200_000) -> None:
+        self.max_events = int(max_events)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._local = threading.local()
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+
+    # ------------------------------------------------------------ record
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _depth(self) -> int:
+        return getattr(self._local, "depth", 0)
+
+    def _record(self, event: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(event)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Record a complete event covering the with-block."""
+        depth = self._depth()
+        self._local.depth = depth + 1
+        start = self._now_us()
+        try:
+            yield self
+        finally:
+            dur = self._now_us() - start
+            self._local.depth = depth
+            args: Dict[str, object] = {"depth": depth}
+            args.update(attrs)
+            self._record({
+                "name": name, "ph": "X", "ts": start, "dur": dur,
+                "pid": self._pid, "tid": threading.get_ident(),
+                "args": args,
+            })
+
+    def instant(self, name: str, **attrs) -> None:
+        """Record a zero-duration instant event (scope: thread)."""
+        self._record({
+            "name": name, "ph": "i", "s": "t", "ts": self._now_us(),
+            "pid": self._pid, "tid": threading.get_ident(),
+            "args": dict(attrs),
+        })
+
+    # ------------------------------------------------------------ export
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def to_chrome(self) -> dict:
+        """Chrome trace-event JSON object (Perfetto-loadable)."""
+        meta = [{
+            "name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
+            "args": {"name": "fed_tgan_tpu host"},
+        }]
+        return {"traceEvents": meta + self.events(),
+                "displayTimeUnit": "ms"}
+
+    def export(self, path: str) -> str:
+        """Write the Chrome-trace JSON to ``path``; returns ``path``."""
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(self.to_chrome(), fh)
+        return path
+
+    def phase_summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-span-name attribution: ``{name: {count, total_ms, mean_ms}}``.
+
+        Only top-level occurrences of a name are summed (a span nested
+        inside a same-named parent would double-count its parent), which
+        makes this the host-phase attribution table for bench reports --
+        the collection side that ``scripts/trace_attribution.py`` used
+        to rebuild from the device trace.
+        """
+        out: Dict[str, Dict[str, float]] = {}
+        for ev in self.events():
+            if ev.get("ph") != "X":
+                continue
+            rec = out.setdefault(ev["name"],
+                                 {"count": 0.0, "total_ms": 0.0})
+            rec["count"] += 1
+            rec["total_ms"] += ev.get("dur", 0.0) / 1e3
+        for rec in out.values():
+            rec["mean_ms"] = rec["total_ms"] / max(1.0, rec["count"])
+        return out
+
+
+_INSTALL_LOCK = threading.Lock()
+_TRACER: Optional[Tracer] = None
+
+
+def start_tracing(max_events: int = 200_000) -> Tracer:
+    """Install (or return the already-installed) process tracer."""
+    global _TRACER
+    with _INSTALL_LOCK:
+        if _TRACER is None:
+            _TRACER = Tracer(max_events=max_events)
+        return _TRACER
+
+
+def stop_tracing() -> Optional[Tracer]:
+    """Uninstall and return the process tracer (None when inactive)."""
+    global _TRACER
+    with _INSTALL_LOCK:
+        t, _TRACER = _TRACER, None
+        return t
+
+
+def current_tracer() -> Optional[Tracer]:
+    return _TRACER
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Span against the installed tracer; free no-op when none is."""
+    t = _TRACER
+    if t is None:
+        yield None
+        return
+    with t.span(name, **attrs):
+        yield t

@@ -18,6 +18,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+
 CLIENTS_AXIS = "clients"
 
 
@@ -161,10 +163,12 @@ def probe_backend_responsive(
             os.close(fd)
         except OSError:
             pass
+        _emit_event("backend_probe", ok=True, attempts=attempt)
         return True, "" if attempt == 1 else f"ok after {attempt} attempts"
     if attempts > 1:
         reason += f" (after {attempts} attempts over ~" \
                   f"{(attempts * timeout_s + (attempts - 1) * backoff_s) / 60:.0f} min)"
+    _emit_event("backend_probe", ok=False, reason=reason)
     return False, reason
 
 

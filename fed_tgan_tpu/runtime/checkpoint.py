@@ -38,6 +38,14 @@ import shutil
 import jax
 import numpy as np
 
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.registry import counter as _metric_counter
+
+_CKPT_SAVES = _metric_counter(
+    "fed_tgan_checkpoints_saved_total", "crash-safe checkpoints published")
+_CKPT_RESTORES = _metric_counter(
+    "fed_tgan_checkpoints_restored_total", "checkpoints loaded for resume")
+
 log = logging.getLogger("fed_tgan_tpu.checkpoint")
 
 FORMAT_VERSION = 2  # v2: optional EMA leaves in federated checkpoints
@@ -262,6 +270,9 @@ def save_federated(trainer, path: str, run_name: str | None = None,
             shutil.rmtree(tmp, ignore_errors=True)
         raise
     _publish_dir(tmp, path, keep)
+    _CKPT_SAVES.inc()
+    _emit_event("checkpoint", path=str(path), kind=host["kind"],
+                round=int(host["completed_epochs"]), keep=int(keep))
 
 
 def load_federated(path: str, mesh=None):
@@ -323,6 +334,9 @@ def load_federated(path: str, mesh=None):
         for k, v in host.get("phase_times", {}).items():
             trainer.phase_times[k] = list(v)
     trainer.run_name = host.get("run_name")
+    _CKPT_RESTORES.inc()
+    _emit_event("checkpoint_restore", path=str(path), kind=kind,
+                round=int(trainer.completed_epochs))
     return trainer
 
 

@@ -7,11 +7,21 @@ snapshot transfer — needs the XLA device timeline.  ``device_trace`` wraps
 ``jax.profiler`` (TensorBoard profile plugin / Perfetto output) as a
 best-effort context manager: a backend that cannot trace (some tunneled
 transports) degrades to a warning, never a failed run.
+
+Outcomes go through the logger and the run journal (``device_trace``
+events) instead of bare prints, and the context yields the trace dir
+(or ``None`` when tracing could not start) so callers can record where
+the device timeline landed next to their own host spans.
 """
 
 from __future__ import annotations
 
 import contextlib
+import logging
+
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+
+log = logging.getLogger("fed_tgan_tpu.profiling")
 
 
 @contextlib.contextmanager
@@ -23,18 +33,22 @@ def device_trace(profile_dir: str):
         jax.profiler.start_trace(profile_dir)
         started = True
     except Exception as exc:  # pragma: no cover - backend-dependent
-        print(f"WARNING: profiler trace unavailable ({exc}); "
-              "running untraced")
+        log.warning("profiler trace unavailable (%s); running untraced", exc)
+        _emit_event("device_trace", dir=str(profile_dir), ok=False,
+                    error=str(exc))
     try:
-        yield
+        yield profile_dir if started else None
     finally:
         if started:
             try:
                 jax.profiler.stop_trace()
-                print(f"profiler trace written to {profile_dir} "
-                      "(open with TensorBoard -> Profile, or Perfetto)")
+                log.info("profiler trace written to %s (open with "
+                         "TensorBoard -> Profile, or Perfetto)", profile_dir)
+                _emit_event("device_trace", dir=str(profile_dir), ok=True)
             except Exception as exc:  # pragma: no cover - backend-dependent
                 # never mask the traced body's exception with a profiler
                 # teardown failure (best-effort contract)
-                print(f"WARNING: profiler stop_trace failed ({exc}); "
-                      "trace may be incomplete")
+                log.warning("profiler stop_trace failed (%s); trace may be "
+                            "incomplete", exc)
+                _emit_event("device_trace", dir=str(profile_dir), ok=False,
+                            error=str(exc))

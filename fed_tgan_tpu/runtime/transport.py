@@ -46,7 +46,20 @@ import threading
 import time
 from typing import Any, Optional
 
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.registry import counter as _metric_counter
+
 log = logging.getLogger("fed_tgan_tpu.transport")
+
+_RECONNECTS = _metric_counter(
+    "fed_tgan_transport_reconnects_total",
+    "transport connections re-established after a drop")
+_DROPS = _metric_counter(
+    "fed_tgan_transport_drops_total",
+    "peers marked dead by the server")
+_LAPSES = _metric_counter(
+    "fed_tgan_transport_heartbeat_lapses_total",
+    "heartbeat liveness deadlines exceeded")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libfttransport.so")
@@ -255,6 +268,10 @@ class ServerTransport(_Endpoint):
         super().__init__(handle)
         self.n_clients = n_clients
         self.dropped: set[int] = set()
+        # guards membership + per-rank sequence/liveness maps: the maps are
+        # read on the protocol thread but a future multi-threaded server
+        # (and jaxlint J05) require every mutation to ride under one lock
+        self._state_lock = threading.Lock()
         now = time.monotonic()
         self._send_seq = {r: 0 for r in range(1, n_clients + 1)}
         self._recv_seq = {r: 0 for r in range(1, n_clients + 1)}
@@ -276,8 +293,11 @@ class ServerTransport(_Endpoint):
     def mark_dropped(self, rank: int, reason: str = "") -> None:
         if rank in self.dropped:
             return
-        self.dropped.add(rank)
+        with self._state_lock:
+            self.dropped.add(rank)
         self._lib.ft_peer_close(self._handle, rank)
+        _DROPS.inc()
+        _emit_event("transport_drop", rank=rank, reason=reason)
         log.warning("transport: dropped client rank %d%s", rank,
                     f" ({reason})" if reason else "")
 
@@ -292,7 +312,10 @@ class ServerTransport(_Endpoint):
             log.warning("transport: refused reconnect from dropped rank %d", rank)
             return None
         self._resync(rank)
-        self._last_alive[rank] = time.monotonic()
+        with self._state_lock:
+            self._last_alive[rank] = time.monotonic()
+        _RECONNECTS.inc()
+        _emit_event("transport_reconnect", role="server", rank=rank)
         log.warning("transport: client rank %d reconnected", rank)
         return rank
 
@@ -323,6 +346,9 @@ class ServerTransport(_Endpoint):
     def _check_liveness(self, rank: int) -> None:
         lapse_s = self.deadlines.heartbeat_timeout_ms / 1000.0
         if time.monotonic() - self._last_alive[rank] > lapse_s:
+            _LAPSES.inc()
+            _emit_event("heartbeat_lapse", rank=rank,
+                        timeout_ms=self.deadlines.heartbeat_timeout_ms)
             raise PeerDeadError(
                 f"rank {rank}: heartbeat lapsed "
                 f"(> {self.deadlines.heartbeat_timeout_ms} ms without a frame)"
@@ -354,8 +380,9 @@ class ServerTransport(_Endpoint):
                 # connection gone: wait for the client to reconnect, resync,
                 # then retry (the sequence number makes the retry idempotent)
                 self._await_reconnect(rank, deadline)
-        self._send_seq[rank] = seq
-        self._last_sent[rank] = frame
+        with self._state_lock:
+            self._send_seq[rank] = seq
+            self._last_sent[rank] = frame
 
     def recv_obj(self, rank: int, timeout_ms: int | None = None) -> Any:
         if rank in self.dropped:
@@ -385,7 +412,8 @@ class ServerTransport(_Endpoint):
             except TransportError:
                 self._await_reconnect(rank, deadline)
                 continue
-            self._last_alive[rank] = time.monotonic()
+            with self._state_lock:
+                self._last_alive[rank] = time.monotonic()
             seq, mtype, payload = _unframe(raw)
             if mtype == _HEARTBEAT:
                 continue
@@ -406,7 +434,8 @@ class ServerTransport(_Endpoint):
                     f"rank {rank}: sequence gap (got {seq}, "
                     f"expected {self._recv_seq[rank] + 1})"
                 )
-            self._recv_seq[rank] = seq
+            with self._state_lock:
+                self._recv_seq[rank] = seq
             return pickle.loads(payload)
 
     def _await_reconnect(self, rank: int, deadline: float) -> None:
@@ -557,6 +586,9 @@ class ClientTransport(_Endpoint):
                 self._handle = handle
             try:
                 self._resync()
+                _RECONNECTS.inc()
+                _emit_event("transport_reconnect", role="client",
+                            rank=self.rank, attempts=attempt + 1)
                 log.warning("transport: rank %d reconnected and resynced",
                             self.rank)
                 return

@@ -1,22 +1,29 @@
 """Serving observability: counters behind ``/healthz`` and ``/metrics``.
 
+Re-implemented on the unified :mod:`fed_tgan_tpu.obs.registry` layer
+(PR 6): the counters and the latency reservoir are real registry
+metrics, so a service's numbers can be merged with the process-wide
+training/transport metrics while keeping the exact snapshot keys and
+Prometheus text format the serve tests and dashboards were built on.
+
 Thread-safe (the HTTP handler threads record sheds, the batch worker
-records completions).  Latency quantiles come from a bounded reservoir of
-the most recent requests — constant memory under sustained traffic, exact
-over any bench-sized window.  ``render_prometheus`` emits the plain-text
-exposition format so a scraper (or ``curl | grep``) works unmodified.
+records completions); locking lives inside the registry metric types.
+Latency quantiles come from the histogram's bounded reservoir of the
+most recent requests — constant memory under sustained traffic, exact
+over any bench-sized window.  Still importable before jax/numpy
+warm-up: the obs registry is pure stdlib by contract.
 """
 
 from __future__ import annotations
 
-import threading
 import time
-from collections import deque
+from typing import Optional
+
+from fed_tgan_tpu.obs.registry import MetricsRegistry
 
 
 def _quantile(sorted_vals: list, q: float) -> float:
-    """Nearest-rank quantile on an already-sorted list (no numpy: the
-    metrics path must stay importable before jax/numpy warm-up)."""
+    """Nearest-rank quantile on an already-sorted list."""
     if not sorted_vals:
         return 0.0
     idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
@@ -24,63 +31,104 @@ def _quantile(sorted_vals: list, q: float) -> float:
 
 
 class ServiceMetrics:
-    """Request/batch counters for one :class:`~.service.SamplingService`."""
+    """Request/batch counters for one :class:`~.service.SamplingService`.
 
-    def __init__(self, reservoir: int = 4096):
-        self._lock = threading.Lock()
-        self._lat = deque(maxlen=reservoir)  # seconds, enqueue -> response ready
+    Each instance owns an isolated :class:`MetricsRegistry` by default
+    (one service = one scrape target); pass ``registry=`` to publish
+    into a shared one instead.
+    """
+
+    def __init__(self, reservoir: int = 4096,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
         self.started_at = time.time()
-        self.requests_total = 0
-        self.rows_total = 0
-        self.batches_total = 0
-        self.shed_total = 0
-        self.errors_total = 0
-        self.reloads_total = 0
+        self._requests = self.registry.counter(
+            "requests_total", "sampling requests answered")
+        self._rows = self.registry.counter(
+            "rows_total", "synthetic rows returned")
+        self._batches = self.registry.counter(
+            "batches_total", "worker micro-batches executed")
+        self._shed = self.registry.counter(
+            "shed_total", "requests shed at admission")
+        self._errors = self.registry.counter(
+            "errors_total", "requests failed")
+        self._reloads = self.registry.counter(
+            "reloads_total", "model hot reloads")
+        # seconds, enqueue -> response ready
+        self._latency = self.registry.histogram(
+            "latency_seconds", "request latency (s)", reservoir=reservoir)
+
+    # ------------------------------------------------- attribute compat
+    # pre-registry callers read these as plain ints
+
+    @property
+    def requests_total(self) -> int:
+        return int(self._requests.value)
+
+    @property
+    def rows_total(self) -> int:
+        return int(self._rows.value)
+
+    @property
+    def batches_total(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def shed_total(self) -> int:
+        return int(self._shed.value)
+
+    @property
+    def errors_total(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def reloads_total(self) -> int:
+        return int(self._reloads.value)
+
+    # ---------------------------------------------------------- record
 
     def record_batch(self, n_requests: int) -> None:
-        with self._lock:
-            self.batches_total += 1
+        self._batches.inc()
 
     def record_request(self, latency_s: float, rows: int) -> None:
-        with self._lock:
-            self.requests_total += 1
-            self.rows_total += rows
-            self._lat.append(latency_s)
+        self._requests.inc()
+        self._rows.inc(rows)
+        self._latency.observe(latency_s)
 
     def record_shed(self) -> None:
-        with self._lock:
-            self.shed_total += 1
+        self._shed.inc()
 
     def record_error(self) -> None:
-        with self._lock:
-            self.errors_total += 1
+        self._errors.inc()
 
     def record_reload(self) -> None:
-        with self._lock:
-            self.reloads_total += 1
+        self._reloads.inc()
+
+    # --------------------------------------------------------- export
 
     def snapshot(self, queue_depth: int = 0) -> dict:
-        with self._lock:
-            lat = sorted(self._lat)
-            uptime = max(time.time() - self.started_at, 1e-9)
-            return {
-                "uptime_s": round(uptime, 3),
-                "requests_total": self.requests_total,
-                "rows_total": self.rows_total,
-                "batches_total": self.batches_total,
-                "shed_total": self.shed_total,
-                "errors_total": self.errors_total,
-                "reloads_total": self.reloads_total,
-                "queue_depth": queue_depth,
-                # requests coalesced per worker cycle; > 1 means
-                # micro-batching is actually kicking in under load
-                "batch_occupancy": round(
-                    self.requests_total / self.batches_total, 3
-                ) if self.batches_total else 0.0,
-                "rows_per_sec": round(self.rows_total / uptime, 1),
-                "latency_p50_ms": round(_quantile(lat, 0.50) * 1e3, 2),
-                "latency_p99_ms": round(_quantile(lat, 0.99) * 1e3, 2),
-            }
+        lat = self._latency.reservoir_values()
+        uptime = max(time.time() - self.started_at, 1e-9)
+        requests = self.requests_total
+        rows = self.rows_total
+        batches = self.batches_total
+        return {
+            "uptime_s": round(uptime, 3),
+            "requests_total": requests,
+            "rows_total": rows,
+            "batches_total": batches,
+            "shed_total": self.shed_total,
+            "errors_total": self.errors_total,
+            "reloads_total": self.reloads_total,
+            "queue_depth": queue_depth,
+            # requests coalesced per worker cycle; > 1 means
+            # micro-batching is actually kicking in under load
+            "batch_occupancy": round(requests / batches, 3)
+            if batches else 0.0,
+            "rows_per_sec": round(rows / uptime, 1),
+            "latency_p50_ms": round(_quantile(lat, 0.50) * 1e3, 2),
+            "latency_p99_ms": round(_quantile(lat, 0.99) * 1e3, 2),
+        }
 
     def render_prometheus(self, queue_depth: int = 0,
                           prefix: str = "fed_tgan_serving") -> str:

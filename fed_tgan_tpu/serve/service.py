@@ -34,6 +34,7 @@ import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from fed_tgan_tpu.obs.journal import emit as _emit_event
 from fed_tgan_tpu.serve.engine import ConditionError, SamplingEngine
 from fed_tgan_tpu.serve.metrics import ServiceMetrics
 from fed_tgan_tpu.serve.registry import ModelRegistry
@@ -213,6 +214,9 @@ class SamplingService:
             if self.registry.maybe_reload():
                 kept = self.engine.adopt(self.registry.get())
                 self.metrics.record_reload()
+                _emit_event("serve_reload",
+                            model_id=self.registry.get().model_id,
+                            programs_kept=bool(kept))
                 self._log(
                     f"service: now serving model "
                     f"{self.registry.get().model_id} "

@@ -29,6 +29,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fed_tgan_tpu.analysis.sanitizers import hot_region
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.registry import counter as _metric_counter
+from fed_tgan_tpu.obs.trace import span as _span
 from fed_tgan_tpu.federation.init import FederatedInit, renormalize_weights
 from fed_tgan_tpu.ops.segments import SegmentSpec
 from fed_tgan_tpu.parallel.fedavg import (
@@ -50,6 +53,17 @@ from fed_tgan_tpu.train.steps import (
     init_models,
     make_train_step,
 )
+
+_ROUNDS_TOTAL = _metric_counter(
+    "fed_tgan_training_rounds_total", "federated rounds completed")
+_CHUNKS_TOTAL = _metric_counter(
+    "fed_tgan_training_chunks_total", "fused round-chunks dispatched")
+_QUARANTINED_TOTAL = _metric_counter(
+    "fed_tgan_training_quarantined_rounds_total",
+    "client-rounds quarantined by the update gate")
+_DROPPED_TOTAL = _metric_counter(
+    "fed_tgan_training_clients_dropped_total",
+    "clients dropped from the federation")
 
 
 def _pad_to(arr: jax.Array | np.ndarray, size: int, axis: int = 0) -> np.ndarray:
@@ -334,7 +348,8 @@ class RoundBookkeeping:
         self.completed_epochs += 1
         if sample_hook is not None:
             t1 = time.time()
-            sample_hook(e, self)
+            with _span("train.snapshot", round=e):
+                sample_hook(e, self)
             t_hook = time.time() - t1
             self.phase_times["distribution"][-1] = pre_hook_s + t_hook
             self.epoch_times[-1] = t_round + pre_hook_s + t_hook
@@ -566,6 +581,9 @@ class FederatedTrainer(RoundBookkeeping):
                 f"clients, below min_clients={self.min_clients}"
             )
         self.dropped_clients.add(idx)
+        _DROPPED_TOTAL.inc()
+        _emit_event("client_dropped", client=int(idx), reason=reason,
+                    survivors=survivors)
         alive = np.ones(self.n_clients, dtype=bool)
         alive[list(self.dropped_clients)] = False
         self.weights = renormalize_weights(self.weights, alive)
@@ -673,8 +691,11 @@ class FederatedTrainer(RoundBookkeeping):
             # (first entry per region compiles and stays unguarded)
             region = f"train.federated.epoch[r{size}" \
                      f"{'+fault' if update_fault else ''}]"
+            # the span is host-side timing only (no device sync), so it
+            # wraps the hot region without perturbing the transfer guard
             if use_ema:
-                with hot_region(region):
+                with _span("train.local_steps", rounds=size), \
+                        hot_region(region):
                     (models, metrics, self._key, finite,
                      self.ema) = self._epoch_fn_for(size, update_fault)(
                         models, data, cond, rows, steps, weights, self._key,
@@ -682,7 +703,8 @@ class FederatedTrainer(RoundBookkeeping):
                     )
                 self._ema_updates += size
             else:
-                with hot_region(region):
+                with _span("train.local_steps", rounds=size), \
+                        hot_region(region):
                     (models, metrics, self._key,
                      finite) = self._epoch_fn_for(size, update_fault)(
                         models, data, cond, rows, steps, weights, self._key
@@ -708,8 +730,10 @@ class FederatedTrainer(RoundBookkeeping):
             # queue the snapshot's generation program behind the chunk
             # BEFORE the host sync: the device goes train -> sample
             # back-to-back instead of idling a host round trip
-            t_pre = self._maybe_predispatch(
-                sample_hook if last in firing else None, last, on_nonfinite)
+            with _span("train.snapshot.predispatch", round=last):
+                t_pre = self._maybe_predispatch(
+                    sample_hook if last in firing else None, last,
+                    on_nonfinite)
             # epoch_times feeds timestamp_experiment.csv — must measure the
             # chunk's real wall-clock, not async dispatch latency.  The sync
             # must come BEFORE bool(finite): a runtime failure poisons every
@@ -723,7 +747,8 @@ class FederatedTrainer(RoundBookkeeping):
             # sync on the cheap already-in-flight finite scalar — contract-
             # equivalent to syncing the full pytree (see _sync_or_rollback);
             # measured wall-neutral on the tunneled chip (PARITY.md)
-            self._sync_or_rollback(finite, _rollback, sample_hook)
+            with _span("train.aggregate.sync", rounds=size):
+                self._sync_or_rollback(finite, _rollback, sample_hook)
             ok = on_nonfinite == "ignore" or bool(finite)
             # every consumer of metric VALUES below (divergence naming,
             # quarantine counts, health watchdog, log means) reads this
@@ -737,7 +762,8 @@ class FederatedTrainer(RoundBookkeeping):
                 or log_due
                 or (isinstance(metrics, dict) and "quarantined" in metrics)
             )
-            metrics_host = jax.device_get(metrics) if need_host else None
+            with _span("train.monitor", pulled=bool(need_host)):
+                metrics_host = jax.device_get(metrics) if need_host else None
             if not ok:
                 self._check_finite(metrics_host, e, on_nonfinite)
             if isinstance(metrics_host, dict) and \
@@ -746,6 +772,7 @@ class FederatedTrainer(RoundBookkeeping):
                 if q.any():
                     counts = q.sum(axis=0).astype(np.int64)
                     self._strikes += counts
+                    _QUARANTINED_TOTAL.inc(int(counts.sum()))
                     import logging
 
                     logg = logging.getLogger("fed_tgan_tpu.train")
@@ -756,6 +783,11 @@ class FederatedTrainer(RoundBookkeeping):
                             idx, counts[idx], e, e + size - 1,
                             self._strikes[idx], self.quarantine_strikes,
                         )
+                        _emit_event(
+                            "quarantine", client=int(idx),
+                            rounds=int(counts[idx]), first=e,
+                            last=e + size - 1,
+                            strikes=int(self._strikes[idx]))
                     # evict repeat offenders (clean RuntimeError below the
                     # min_clients floor); survivors' weights renormalize
                     for idx in np.nonzero(
@@ -778,6 +810,15 @@ class FederatedTrainer(RoundBookkeeping):
                     sample_hook if (ei == last and ei in firing) else None,
                     pre_hook_s=t_pre if ei == last else 0.0,
                 )
+            # journal/counters see only host-side values already in hand
+            # (per_round, ok, membership) -- no extra device pull
+            _ROUNDS_TOTAL.inc(size)
+            _CHUNKS_TOTAL.inc()
+            _emit_event("round", first=e, last=last, rounds=size,
+                        per_round_s=round(per_round, 6), finite=bool(ok))
+            _emit_event("aggregate", first=e, last=last,
+                        aggregator=self.cfg.aggregator,
+                        clients=self.n_clients - len(self.dropped_clients))
             if log_due:
                 m = jax.tree.map(lambda x: np.asarray(x).mean(),
                                  metrics_host)

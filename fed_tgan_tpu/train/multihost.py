@@ -33,6 +33,9 @@ import numpy as np
 
 import jax
 
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.registry import counter as _metric_counter
+from fed_tgan_tpu.obs.trace import span as _span
 from fed_tgan_tpu.ops.segments import SegmentSpec
 from fed_tgan_tpu.parallel.multihost import (
     from_local_chunk,
@@ -50,6 +53,12 @@ from fed_tgan_tpu.train.steps import (
     config_signature,
     init_models,
 )
+
+# get-or-create: same process-wide counters the single-host trainer uses
+_MH_ROUNDS = _metric_counter(
+    "fed_tgan_training_rounds_total", "federated rounds completed")
+_MH_CHUNKS = _metric_counter(
+    "fed_tgan_training_chunks_total", "fused round-chunks dispatched")
 
 
 @dataclass(frozen=True)
@@ -147,9 +156,12 @@ def _save_participant(run: MultihostRun, rank: int, models_g, chain,
     os.makedirs(run.ckpt_dir, exist_ok=True)
     path = _ckpt_path(run, rank)
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(state, f)
-    os.replace(tmp, path)  # atomic: a crash mid-write never corrupts
+    with _span("multihost.checkpoint", rank=rank):
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, path)  # atomic: a crash mid-write never corrupts
+    _emit_event("checkpoint", path=path, kind="multihost_participant",
+                rank=rank, round=int(epochs_done))
 
 
 def _load_participant(run: MultihostRun, rank: int, n_clients: int,
@@ -404,15 +416,20 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                 )
             t0 = time.time()
             if use_ema:
-                models_g, metrics, chain, _finite, ema_g = epoch_fns[fn_key](
-                    models_g, data_g, cond_g, rows_g, steps_g, weights_g,
-                    chain, ema_g,
-                )
+                with _span("multihost.local_steps", rank=transport.rank,
+                           rounds=size):
+                    models_g, metrics, chain, _finite, ema_g = \
+                        epoch_fns[fn_key](
+                            models_g, data_g, cond_g, rows_g, steps_g,
+                            weights_g, chain, ema_g,
+                        )
             else:
-                models_g, metrics, chain, _finite = epoch_fns[fn_key](
-                    models_g, data_g, cond_g, rows_g, steps_g, weights_g,
-                    chain,
-                )
+                with _span("multihost.local_steps", rank=transport.rank,
+                           rounds=size):
+                    models_g, metrics, chain, _finite = epoch_fns[fn_key](
+                        models_g, data_g, cond_g, rows_g, steps_g, weights_g,
+                        chain,
+                    )
             last = e + size - 1
             finish = None
             snap_due = sender is not None and last in firing
@@ -439,6 +456,9 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
                 )
             jax.block_until_ready(models_g)
             seconds = time.time() - t0
+            _emit_event("round", role="client", rank=transport.rank,
+                        first=e, last=last, rounds=size,
+                        per_round_s=round(seconds / size, 6))
 
             if sender is not None:
                 # rank 1 is the reporting participant: post-psum state is
@@ -587,6 +607,12 @@ def server_train(
             if "decode_tables" in msg:
                 assemble = make_assemble_packed_q(msg["decode_tables"])
             per_round = msg["seconds"] / msg["rounds"]
+            _MH_ROUNDS.inc(msg["rounds"])
+            _MH_CHUNKS.inc()
+            _emit_event("round", role="server",
+                        first=msg["last"] - msg["rounds"] + 1,
+                        last=msg["last"], rounds=msg["rounds"],
+                        per_round_s=round(per_round, 6))
             snap = msg.get("snapshot_parts")
             for i in range(msg["rounds"]):
                 ei = msg["last"] - msg["rounds"] + 1 + i

@@ -28,7 +28,15 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.registry import counter as _metric_counter
+
 log = logging.getLogger("fed_tgan_tpu.watchdog")
+
+_ALARMS_TOTAL = _metric_counter(
+    "fed_tgan_watchdog_alarms_total", "training-health alarms raised")
+_ROLLBACKS_TOTAL = _metric_counter(
+    "fed_tgan_watchdog_rollbacks_total", "automatic checkpoint rollbacks")
 
 
 class WatchdogAlarm(RuntimeError):
@@ -154,6 +162,10 @@ def fit_with_watchdog(
             trainer.fit(target - trainer.completed_epochs, **fit_kwargs)
         except WatchdogAlarm as alarm:
             watchdog.rollbacks += 1
+            _ALARMS_TOTAL.inc()
+            _emit_event("watchdog_alarm", reason=str(alarm),
+                        round=int(trainer.completed_epochs),
+                        rollbacks=watchdog.rollbacks)
             log.warning("watchdog alarm (%s); rollback %d/%d",
                         alarm, watchdog.rollbacks,
                         watchdog.cfg.max_rollbacks)
@@ -192,6 +204,10 @@ def fit_with_watchdog(
             trainer._epoch_fns = {}  # lr is baked into the compiled programs
             watchdog.reset_window()
             restore_round = trainer.completed_epochs
+            _ROLLBACKS_TOTAL.inc()
+            _emit_event("watchdog_rollback", restored_from=str(src),
+                        round=int(trainer.completed_epochs),
+                        generation_skip=gen_skip, lr=float(trainer.cfg.lr))
             log.warning(
                 "rolled back to %s (round %d); lr re-annealed %g -> %g",
                 src, trainer.completed_epochs, old_lr, trainer.cfg.lr,

@@ -1,0 +1,270 @@
+"""Unified telemetry layer: metrics registry, span tracing, run journal.
+
+Unit coverage for the three obs primitives plus the tier-1 gate: one
+federated round on a 2-device CPU mesh, instrumented end-to-end, emits
+the round -> aggregate -> checkpoint journal sequence while the
+device->host transfer guard is armed -- proof the instrumentation adds
+zero device syncs to the hot path.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.obs import (
+    MetricsRegistry,
+    RunJournal,
+    Tracer,
+    emit,
+    get_registry,
+    read_journal,
+    set_journal,
+    span,
+    start_tracing,
+    stop_tracing,
+)
+from fed_tgan_tpu.obs.report import render_text, summarize
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_uninstalled():
+    """Tests must not leak a process-wide journal/tracer install."""
+    yield
+    set_journal(None)
+    stop_tracing()
+
+
+# ----------------------------------------------------- metrics registry
+
+def test_counter_threaded_increments():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "threaded")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_registry_get_or_create_identity_and_kind_collision():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total")
+    assert reg.counter("x_total") is a
+    with pytest.raises(TypeError):
+        reg.gauge("x_total")
+    g = reg.gauge("depth")
+    g.set(3)
+    g.dec()
+    assert g.value == 2
+
+
+def test_histogram_buckets_and_quantile():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.02, 0.02, 0.5, 2.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["le_0.01"] == 1       # cumulative: <= 0.01
+    assert snap["le_0.1"] == 3
+    assert snap["le_1"] == 4          # 2.0 only in the +Inf tail
+    assert h.quantile(0.0) == 0.005
+    assert h.quantile(1.0) == 2.0
+    assert h.reservoir_values() == sorted([0.005, 0.02, 0.02, 0.5, 2.0])
+
+
+def test_prometheus_rendering():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests").inc(4)
+    reg.histogram("lat", buckets=(0.1, 1.0)).observe(0.05)
+    text = reg.render_prometheus()
+    assert "# TYPE req_total counter" in text
+    assert "req_total 4" in text
+    assert '# TYPE lat histogram' in text
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="+Inf"} 1' in text
+    assert "lat_count 1" in text
+    assert text.endswith("\n")
+
+
+def test_default_registry_is_process_wide():
+    c = get_registry().counter("obs_test_shared_total")
+    assert get_registry().counter("obs_test_shared_total") is c
+
+
+# ------------------------------------------------------- span tracing
+
+def test_span_nesting_depth_and_chrome_json():
+    tr = Tracer()
+    with tr.span("outer", phase="a"):
+        with tr.span("inner"):
+            pass
+    tr.instant("marker", note=1)
+    events = tr.events()
+    by_name = {e["name"]: e for e in events}
+    assert by_name["inner"]["args"]["depth"] == 1
+    assert by_name["outer"]["args"]["depth"] == 0
+    assert by_name["outer"]["args"]["phase"] == "a"
+    assert by_name["inner"]["dur"] <= by_name["outer"]["dur"]
+    assert by_name["marker"]["ph"] == "i"
+
+    chrome = json.loads(json.dumps(tr.to_chrome()))  # JSON-serializable
+    assert chrome["displayTimeUnit"] == "ms"
+    assert chrome["traceEvents"][0]["ph"] == "M"  # process_name metadata
+    assert {e["name"] for e in chrome["traceEvents"]} \
+        >= {"outer", "inner", "marker"}
+
+
+def test_tracer_bounded_and_phase_summary():
+    tr = Tracer(max_events=2)
+    for i in range(4):
+        with tr.span("p"):
+            pass
+    assert len(tr.events()) == 2 and tr.dropped == 2
+    phases = tr.phase_summary()
+    assert phases["p"]["count"] == 2
+    assert phases["p"]["mean_ms"] >= 0
+
+
+def test_module_span_noop_without_tracer():
+    assert stop_tracing() is None  # nothing installed
+    with span("free", k=1) as t:
+        assert t is None           # no tracer: free no-op
+    tr = start_tracing()
+    assert start_tracing() is tr   # idempotent install
+    with span("counted") as t:
+        assert t is tr
+    assert stop_tracing() is tr
+    assert "counted" in tr.phase_summary()
+
+
+# -------------------------------------------------------- run journal
+
+def test_journal_round_trip_and_schema(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with RunJournal(path, run_id="rt") as j:
+        j.emit("round", first=0, last=3, rounds=4, per_round_s=0.25)
+        circular = {}
+        circular["self"] = circular
+        j.emit("weird", obj=circular)  # unserializable: degraded, not lost
+    events = list(read_journal(path))
+    assert [e["type"] for e in events] == \
+        ["run_start", "round", "weird", "run_end"]
+    start = events[0]
+    assert start["schema"] == 1 and start["run_id"] == "rt"
+    assert all(isinstance(e["ts"], float) for e in events)
+    assert events[1]["rounds"] == 4
+    assert events[2]["error"] == "unserializable fields dropped"
+
+    # torn tail line (crash mid-write) must not break the reader
+    with open(path, "a") as fh:
+        fh.write('{"type": "round", "first":')
+    assert len(list(read_journal(path))) == 4
+
+
+def test_module_emit_noop_when_uninstalled(tmp_path):
+    set_journal(None)
+    assert emit("round", first=0) is None  # no journal: swallowed
+    j = RunJournal(str(tmp_path / "m.jsonl"), run_id="m")
+    set_journal(j)
+    assert emit("round", first=0)["type"] == "round"
+    set_journal(None)
+    j.close()
+    types = [e["type"] for e in read_journal(j.path)]
+    assert types == ["run_start", "round", "run_end"]
+
+
+def test_report_summarize(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    with RunJournal(path, run_id="rep") as j:
+        j.emit("round", first=0, last=7, rounds=8, per_round_s=0.5)
+        j.emit("aggregate", first=0, last=7, aggregator="fedavg", clients=2)
+        j.emit("watchdog_alarm", reason="boom", round=7)
+        j.emit("quarantine", client=1, rounds=2)
+        j.emit("compile", program="epoch_local")
+        j.emit("checkpoint", path="/tmp/ck", kind="federated", round=8)
+    s = summarize(path)
+    assert s["run_id"] == "rep" and s["schema"] == 1
+    assert s["events"] == 8  # run_start + 6 + run_end
+    assert s["rounds"] == {"chunks": 1, "total_rounds": 8,
+                           "per_round_s_mean": 0.5, "per_round_s_max": 0.5}
+    assert s["watchdog"]["alarms"] == 1 and s["watchdog"]["reasons"] == ["boom"]
+    assert s["robustness"]["quarantine_events"] == 1
+    assert s["compiles"] == {"epoch_local": 1}
+    assert s["checkpoints"]["saved"] == 1
+    text = render_text(s)
+    assert "rounds: 8 in 1 chunk(s)" in text and "watchdog: 1 alarm(s)" in text
+
+
+# ------------------------------------- tier-1 gate: instrumented round
+
+@pytest.fixture(scope="module")
+def fed_init2(toy_frame, toy_spec):
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.init import federated_initialize
+
+    shards = shard_dataframe(toy_frame, 2, "iid", seed=9)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    return federated_initialize(clients, seed=0)
+
+
+def test_instrumented_round_emits_journal_with_no_added_d2h(
+        fed_init2, tmp_path):
+    """One federated round on a 2-device mesh, with journal + tracer
+    installed and the device->host transfer guard ARMED (sanitize +
+    hot_region after warmup): the run must emit round -> aggregate ->
+    checkpoint and record the training spans, without tripping the
+    guard -- i.e. the telemetry layer provably adds zero device syncs
+    to the hot path."""
+    from fed_tgan_tpu.analysis import sanitizers
+    from fed_tgan_tpu.analysis.sanitizers import sanitize
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.runtime.checkpoint import save_federated
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    cfg = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                      batch_size=40, pac=4)
+    tr = FederatedTrainer(fed_init2, config=cfg, mesh=client_mesh(2), seed=0)
+    rounds_counter = get_registry().counter("fed_tgan_training_rounds_total")
+    before = rounds_counter.value
+    try:
+        with sanitize():
+            tr.fit(1)  # warmup: traces the program, hot_region unguarded
+
+            journal = RunJournal(str(tmp_path / "run.jsonl"), run_id="gate")
+            set_journal(journal)
+            tracer = start_tracing()
+            tr.fit(1)  # guarded entry: any added d2h raises here
+            save_federated(tr, str(tmp_path / "ckpt"))
+            set_journal(None)
+            journal.close()
+    finally:
+        sanitizers.disable_sanitizers()
+
+    types = [e["type"] for e in read_journal(journal.path)]
+    assert types.index("round") < types.index("aggregate") \
+        < types.index("checkpoint")
+    assert rounds_counter.value == before + 2  # both fits counted
+
+    phases = stop_tracing().phase_summary()
+    assert phases["train.local_steps"]["count"] == 1
+    assert "train.aggregate.sync" in phases
+    assert np.isfinite(phases["train.local_steps"]["total_ms"])
+
+    s = summarize(journal.path)
+    assert s["rounds"]["total_rounds"] == 1
+    assert s["checkpoints"]["saved"] == 1
