@@ -61,10 +61,12 @@ def _ensure_responsive_backend() -> str:
     Returns "" (accelerator fine) or "(cpu-fallback)" to tag the metric.
 
     Probe budget is SPREAD across the run, not burned at startup (VERDICT
-    r04): two quick attempts here (~1 min of backoff), then the CPU
-    fallback proceeds and ``_retry_on_chip`` re-probes AFTER it finishes —
-    if the tunnel healed during the fallback run, the workload re-runs on
-    the chip and the chip number replaces the fallback line.  Every probe
+    r04): two quick attempts here (hard ~15 s deadline each, ~5 s backoff
+    — BENCH_r05 measured the old 120–300 s deadlines burning minutes per
+    wedged probe, so CPU failover is now seconds), then the CPU fallback
+    proceeds and ``_retry_on_chip`` re-probes AFTER it finishes — if the
+    tunnel healed during the fallback run, the workload re-runs on the
+    chip and the chip number replaces the fallback line.  Every probe
     lands in PROBE_HISTORY, which rides the JSON record.
     """
     from fed_tgan_tpu.parallel.mesh import (
@@ -80,7 +82,7 @@ def _ensure_responsive_backend() -> str:
         attempts = 2
     ok, reason = probe_backend_responsive(
         attempts=attempts,
-        backoff_s=60.0,
+        backoff_s=5.0,
         log=lambda msg: print(f"bench: {msg}", file=sys.stderr, flush=True),
     )
     if ok:
@@ -127,8 +129,10 @@ def _retry_on_chip(deadline_min: float) -> dict | None:
 
     print("bench: cpu-fallback run done; re-probing the accelerator for a "
           "chip re-run", file=sys.stderr, flush=True)
+    # post-run probe: a healed tunnel answers fast, a still-wedged one
+    # should cost seconds — same hard deadline as the startup probe
     ok, reason = probe_backend_responsive(
-        attempts=1, timeout_s=300, ignore_cache=True,
+        attempts=1, timeout_s=15, ignore_cache=True,
         log=lambda msg: print(f"bench: {msg}", file=sys.stderr, flush=True),
     )
     _note_probe(ok, reason if not ok else "healed after fallback run")
@@ -310,7 +314,8 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
            bgm_backend: str = "sklearn", df=None, batch_size: int = 500,
            ema_decay: float = 0.0, lr_schedule: str = "constant",
            lr_decay_epochs: int = 0, shard_strategy: str = "iid",
-           alpha: float = 0.5, d_steps: int = 1, pac: int = 10):
+           alpha: float = 0.5, d_steps: int = 1, pac: int = 10,
+           precision: str = "f32"):
     import pandas as pd
 
     from fed_tgan_tpu.data.ingest import TablePreprocessor
@@ -348,6 +353,7 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
                                  lr_schedule=lr_schedule,
                                  lr_decay_steps=lr_decay_steps,
                                  d_steps=d_steps, pac=pac,
+                                 precision=precision,
                                  # skewed splits can leave a client under
                                  # one batch; the reference lets it ride
                                  # with 0 local steps, and the non-IID
@@ -361,7 +367,8 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
 
 def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
                 profile_dir: str | None = None,
-                obs_dir: str | None = "bench_obs_round") -> dict:
+                obs_dir: str | None = "bench_obs_round",
+                precision: str = "f32") -> dict:
     """Seconds per round of the real server loop: every round runs the
     clients' local steps + weighted FedAvg and snapshots 40k rows to a CSV,
     exactly like the reference server (distributed.py:785-829).  The
@@ -398,7 +405,8 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
         set_journal(journal)
         tracer = start_tracing()
     try:
-        _, init, trainer = _setup(bgm_backend=bgm_backend)
+        _, init, trainer = _setup(bgm_backend=bgm_backend,
+                                  precision=precision)
         with tempfile.TemporaryDirectory() as td:
             writer = SnapshotWriter(
                 init.global_meta, init.encoders,
@@ -421,7 +429,8 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
                     writer.drain()
                     value = (time.time() - t0) / rounds
         result = {
-            "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)",
+            "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)"
+                      + ("" if precision == "f32" else f"({precision})"),
             "value": round(value, 4),
             "unit": "s/round",
             "vs_baseline": round(BASELINE_EPOCH_SECONDS / value, 2),
@@ -452,6 +461,7 @@ def bench_full500(
     weighted: bool = True,
     bgm_backend: str = "sklearn",
     sample_every: int = 1,
+    precision: str = "f32",
 ) -> dict:
     """The reference README's full demo: 500 epochs, snapshot CSV per epoch.
 
@@ -482,7 +492,8 @@ def bench_full500(
                    f"{'' if weighted else '_uniform'}")
     t_start = time.time()
     df, init, trainer = _setup(
-        n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend
+        n_clients=n_clients, weighted=weighted, bgm_backend=bgm_backend,
+        precision=precision,
     )
     t_init = time.time() - t_start
 
@@ -506,6 +517,8 @@ def bench_full500(
         real, last_raw, init.global_meta.categorical_columns
     )
     suffix = "" if weighted else "(uniform)"
+    if precision != "f32":
+        suffix += f"({precision})"
     unit = "s"
     if sample_every > 1:
         suffix += f"(sample-every-{sample_every})"
@@ -551,7 +564,8 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
                   batch_size: int = 500, ema_decay: float = 0.0,
                   gan_seed: int = 0, lr_schedule: str = "constant",
                   shard_strategy: str = "iid", alpha: float = 0.5,
-                  d_steps: int = 1, pac: int = 10) -> dict:
+                  d_steps: int = 1, pac: int = 10,
+                  precision: str = "f32") -> dict:
     """Driver-reproducible ΔF1: the reference utility_analysis protocol
     (reference Server/utility_analysis.py:94-119, README.md:67 headline
     0.0850 at 500 epochs on the FULL training CSV).
@@ -601,6 +615,7 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         df=gan_df, batch_size=batch_size, ema_decay=ema_decay,
         seed=gan_seed, lr_schedule=lr_schedule, lr_decay_epochs=epochs,
         shard_strategy=shard_strategy, alpha=alpha, d_steps=d_steps, pac=pac,
+        precision=precision,
     )
     cols = init.global_meta.column_names
     real_train = train_df[cols]
@@ -723,6 +738,8 @@ def bench_utility(epochs: int = 500, n_clients: int = 2,
         suffix += f"(d_steps={d_steps})"
     if pac != 10:
         suffix += f"(pac={pac})"
+    if precision != "f32":
+        suffix += f"({precision})"
     if shard_strategy != "iid":
         suffix += f"({shard_strategy}" + (
             f"-a{alpha:g})" if shard_strategy == "dirichlet" else ")")
@@ -1105,7 +1122,8 @@ def bench_multihost(epochs: int = 10) -> dict:
 
 
 def bench_serving(duration_s: float = 15.0, clients: int = 4,
-                  rows_per_request: int = 200, seed: int = 0) -> dict:
+                  rows_per_request: int = 200, seed: int = 0,
+                  precision: str = "f32") -> dict:
     """Serving throughput/latency: concurrent clients against an in-process
     ``serve.SamplingService`` over a demo artifact.
 
@@ -1127,7 +1145,8 @@ def bench_serving(duration_s: float = 15.0, clients: int = 4,
     tmp = tempfile.mkdtemp(prefix="fed_tgan_bench_serving_")
     svc = None
     try:
-        build_demo_artifact(tmp, rows=400, epochs=1, seed=seed)
+        build_demo_artifact(tmp, rows=400, epochs=1, seed=seed,
+                            precision=precision)
         svc = SamplingService(
             ModelRegistry(tmp, log=lambda *a: None), port=0,
             max_batch=8, queue_size=256, log=lambda *a: None,
@@ -1180,7 +1199,8 @@ def bench_serving(duration_s: float = 15.0, clients: int = 4,
             return lat[min(len(lat) - 1, int(q * len(lat)))] if lat else 0.0
 
         return {
-            "metric": "bench_serving",
+            "metric": "bench_serving"
+                      + ("" if precision == "f32" else f"({precision})"),
             "value": round(rows_done[0] / max(elapsed, 1e-9), 1),
             "unit": "rows/s served",
             "vs_baseline": 0,
@@ -1311,11 +1331,17 @@ def main() -> int:
                          "In-process config pin, same as the CLI flag")
     ap.add_argument("--bgm-backend", choices=["sklearn", "jax"],
                     default=None,
-                    help="init-time GMM fitting: sklearn (reference-exact "
-                         "estimator, default) or the TPU-native vmapped "
-                         "variational-DP program (faster init).  The scale "
-                         "workload defaults to jax (32 clients of serial "
-                         "sklearn fits would dominate the demo)")
+                    help="init-time GMM fitting: jax (default) = the "
+                         "TPU-native vmapped variational-DP program (faster "
+                         "init, no per-column sklearn ConvergenceWarning "
+                         "flood); sklearn = reference-exact estimator on "
+                         "host")
+    ap.add_argument("--precision", choices=["f32", "bf16"], default="f32",
+                    help="round/full500/utility/serving workloads: "
+                         "training+serving numerics (bf16 = mixed "
+                         "precision with f32 islands and half-size FedAvg "
+                         "payloads; metric names carry a '(bf16)' "
+                         "suffix).  f32 = reference-exact (default)")
     args = ap.parse_args()
     if args.csv:
         CSV_PATH = args.csv
@@ -1359,8 +1385,15 @@ def main() -> int:
         ap.error("--ema-decay and --select are mutually exclusive: EMA "
                  "replaces snapshot selection with continuous smoothing, "
                  "and the selection modes stash/restore raw model state")
-    bgm = args.bgm_backend or (
-        "jax" if args.workload == "scale" else "sklearn")
+    # default flipped to the on-device fitter (BENCH_r07): the sklearn
+    # path's per-column ConvergenceWarning flood and serial host fits are
+    # opt-in via --bgm-backend sklearn, not the cost of every bench run
+    bgm = args.bgm_backend or "jax"
+    if args.precision != "f32" and args.workload not in (
+            "round", "full500", "utility", "serving"):
+        ap.error(f"--precision {args.precision} only applies to the "
+                 f"round/full500/utility/serving workloads "
+                 f"(got {args.workload})")
     clients = args.clients if args.clients is not None else {
         "scale": 32, "adult": 8, "serving": 4
     }.get(args.workload, 2)
@@ -1486,11 +1519,12 @@ def _is_backend_unavailable(exc: BaseException) -> bool:
 
 def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
     if args.workload == "serving":
-        return bench_serving(clients=clients)
+        return bench_serving(clients=clients, precision=args.precision)
     if args.workload == "round":
         return bench_round(bgm_backend=bgm,
                            profile_dir=args.profile_dir,
-                           obs_dir=args.obs_dir or None)
+                           obs_dir=args.obs_dir or None,
+                           precision=args.precision)
     if args.workload == "utility":
         return bench_utility(
             epochs, n_clients=clients, weighted=not args.uniform,
@@ -1500,6 +1534,7 @@ def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
             lr_schedule=args.lr_schedule,
             shard_strategy=shard_strategy, alpha=args.alpha,
             d_steps=args.d_steps, pac=args.pac,
+            precision=args.precision,
         )
     if args.workload == "multihost":
         return bench_multihost(epochs)
@@ -1517,6 +1552,7 @@ def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
     return bench_full500(
         epochs, n_clients=clients, weighted=not args.uniform,
         bgm_backend=bgm, sample_every=args.sample_every,
+        precision=args.precision,
     )
 
 

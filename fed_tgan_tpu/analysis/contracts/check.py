@@ -35,14 +35,19 @@ from typing import Callable, Dict, List, Optional
 from fed_tgan_tpu.analysis.contracts.harness import (
     ENTRYPOINT_FAMILIES,
     HarnessError,
+    PROGRAM_REQUIREMENTS,
     lower_fingerprints,
 )
-from fed_tgan_tpu.analysis.contracts.ir import Fingerprint
+from fed_tgan_tpu.analysis.contracts.ir import (
+    Fingerprint,
+    total_collective_bytes,
+)
 
 __all__ = [
     "CONTRACTS_DIR",
     "ContractError",
     "Issue",
+    "check_requirements",
     "diff_contracts",
     "load_contracts",
     "run_contracts",
@@ -116,14 +121,23 @@ def save_contracts(current: Dict[str, Dict[str, Fingerprint]],
                    contracts_dir: Optional[Path] = None) -> List[Path]:
     paths = []
     for family, programs in sorted(current.items()):
+        # requirement blocks come from the code-side registry, never from
+        # the old JSON: --contracts-update regenerates them, and programs
+        # the registry doesn't name (tests' toy entrypoints) get none
+        reqs = PROGRAM_REQUIREMENTS.get(family, {})
+        entries = {}
+        for name, fp in sorted(programs.items()):
+            entry = fp.to_dict()
+            if name in reqs:
+                entry["require"] = reqs[name]
+            entries[name] = entry
         payload = {
             "version": 1,
             "comment": ("lowered-HLO program contract; regenerate with "
                         "python -m fed_tgan_tpu.analysis "
                         "--contracts-update"),
             "forbid_dtypes": list(DEFAULT_FORBID_DTYPES),
-            "programs": {name: fp.to_dict()
-                         for name, fp in sorted(programs.items())},
+            "programs": entries,
         }
         path = _family_path(family, contracts_dir)
         path.write_text(json.dumps(payload, indent=2, sort_keys=True)
@@ -210,6 +224,54 @@ def diff_program(family: str, program: str, stored: dict,
     return issues
 
 
+def check_requirements(family: str, program: str, require: dict,
+                       programs: Dict[str, Fingerprint]) -> List[Issue]:
+    """Evaluate one contract's ``require`` block against the CURRENT
+    family fingerprints (unlike the ratchet, which diffs old vs new,
+    a requirement is an absolute property the program must keep).
+
+    * ``dtypes_present``: each listed dtype must appear in the census —
+      how the bf16 contracts pin both the compute cast (bf16) and the
+      f32 islands (f32);
+    * ``max_collective_bytes_ratio {vs, ratio}``: total collective bytes
+      must stay <= ratio * the named sibling program's total — the
+      "~2x lower aggregation payload" criterion, immune to both programs
+      drifting together.
+    """
+    issues: List[Issue] = []
+    fp = programs[program]
+    for dt in require.get("dtypes_present", ()):
+        if fp.dtypes.get(dt, 0) <= 0:
+            issues.append(Issue(
+                severity=REGRESSION, family=family, program=program,
+                metric=f"require.dtypes_present.{dt}",
+                old="present", new="absent",
+                message=f"required dtype {dt} vanished from the lowered "
+                        "program (precision policy no longer applied?)"))
+    ratio_req = require.get("max_collective_bytes_ratio")
+    if ratio_req:
+        vs, ratio = ratio_req["vs"], float(ratio_req["ratio"])
+        if vs not in programs:
+            issues.append(Issue(
+                severity=REGRESSION, family=family, program=program,
+                metric="require.max_collective_bytes_ratio",
+                old=vs, new="missing",
+                message="baseline program for the payload-ratio "
+                        "requirement is no longer lowered"))
+        else:
+            mine = total_collective_bytes(fp)
+            base = total_collective_bytes(programs[vs])
+            if mine > ratio * base:
+                issues.append(Issue(
+                    severity=REGRESSION, family=family, program=program,
+                    metric="require.max_collective_bytes_ratio",
+                    old=f"<= {ratio} x {base} ({vs})", new=mine,
+                    message="reduced-precision program lost its "
+                            "collective-payload advantage over the f32 "
+                            "twin"))
+    return issues
+
+
 def diff_contracts(current: Dict[str, Dict[str, Fingerprint]],
                    stored: Dict[str, Optional[dict]]) -> List[Issue]:
     issues: List[Issue] = []
@@ -241,6 +303,10 @@ def diff_contracts(current: Dict[str, Dict[str, Fingerprint]],
             else:
                 issues.extend(diff_program(family, name, recorded[name],
                                            programs[name], forbid))
+                require = recorded[name].get("require")
+                if require:
+                    issues.extend(check_requirements(
+                        family, name, require, programs))
     return issues
 
 

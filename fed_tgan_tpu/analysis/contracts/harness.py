@@ -33,6 +33,7 @@ __all__ = [
     "ENTRYPOINT_FAMILIES",
     "HarnessError",
     "N_DEVICES",
+    "PROGRAM_REQUIREMENTS",
     "lower_fingerprints",
     "require_mesh",
 ]
@@ -153,6 +154,11 @@ _EPOCH_VARIANTS = {
     "gated": dict(),
     "median": dict(aggregator="median"),
     "ema": dict(update_gate=False, ema_decay=0.999),
+    # mixed-precision twins of the two production paths: bf16 compute +
+    # bf16 aggregation payloads, f32 islands intact (PROGRAM_REQUIREMENTS
+    # below turns those properties into contract REQUIREMENTS)
+    "weighted@bf16": dict(update_gate=False, precision="bf16"),
+    "gated@bf16": dict(precision="bf16"),
 }
 
 
@@ -191,8 +197,9 @@ def _agg_trees():
     return prev, new, weights, steps
 
 
-def _lower_robust(aggregator: str):
+def _lower_robust(aggregator: str, payload_bf16: bool = False):
     import jax
+    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from fed_tgan_tpu.parallel.fedavg import robust_aggregate
@@ -204,10 +211,12 @@ def _lower_robust(aggregator: str):
 
     require_mesh()
     mesh = client_mesh(N_DEVICES)
+    payload_dtype = jnp.bfloat16 if payload_bf16 else None
 
     def prog(prev, new, w, s):
         return robust_aggregate(prev, new, w, s, k=1,
-                                aggregator=aggregator)
+                                aggregator=aggregator,
+                                payload_dtype=payload_dtype)
 
     fn = shard_map(
         prog, mesh=mesh,
@@ -243,7 +252,33 @@ def _lower_weighted_psum():
     return jax.jit(fn).lower(prev, weights)
 
 
-def _lower_serve(n_steps: int, conditional: bool):
+def _lower_weighted_delta():
+    """The bf16 aggregation: f32-accumulated weighted deltas whose psum
+    payload crosses the wire at bf16 width."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from fed_tgan_tpu.parallel.fedavg import weighted_delta_average
+    from fed_tgan_tpu.parallel.mesh import (
+        CLIENTS_AXIS,
+        client_mesh,
+        shard_map,
+    )
+
+    require_mesh()
+    mesh = client_mesh(N_DEVICES)
+    fn = shard_map(
+        lambda p, n, w: weighted_delta_average(p, n, w),
+        mesh=mesh,
+        in_specs=(P(CLIENTS_AXIS),) * 3,
+        out_specs=P(),
+        check_vma=False,
+    )
+    prev, new, weights, _steps = _agg_trees()
+    return jax.jit(fn).lower(prev, new, weights)
+
+
+def _lower_serve(n_steps: int, conditional: bool, precision: str = "f32"):
     import jax
 
     from fed_tgan_tpu.models.ctgan import init_generator
@@ -252,7 +287,7 @@ def _lower_serve(n_steps: int, conditional: bool):
 
     require_mesh()
     spec = _toy_spec()
-    cfg = _toy_cfg()
+    cfg = _toy_cfg(precision=precision)
     run = build_bucket_program(spec, cfg, None, n_steps, conditional)
     params_g, state_g = init_generator(
         jax.random.key(1), cfg.embedding_dim + spec.n_opt, cfg.gen_dims,
@@ -271,11 +306,72 @@ ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
     },
     "parallel_fedavg": {
         "fedavg[weighted_psum]": _lower_weighted_psum,
+        "fedavg[weighted_delta_bf16]": _lower_weighted_delta,
         **{f"robust_agg[{a}]": (lambda a=a: _lower_robust(a))
+           for a in ("weighted", "clipped", "trimmed", "median")},
+        **{f"robust_agg[{a}@bf16]":
+           (lambda a=a: _lower_robust(a, payload_bf16=True))
            for a in ("weighted", "clipped", "trimmed", "median")},
     },
     "serve_engine": {
-        serve_bucket_name(n, c): (lambda n=n, c=c: _lower_serve(n, c))
+        **{serve_bucket_name(n, c): (lambda n=n, c=c: _lower_serve(n, c))
+           for n in (1, 4) for c in (False, True)},
+        **{serve_bucket_name(n, c, "bf16"):
+           (lambda n=n, c=c: _lower_serve(n, c, "bf16"))
+           for n in (1, 4) for c in (False, True)},
+    },
+}
+
+
+#: program -> REQUIRED properties, attached to the contract JSON by
+#: ``save_contracts`` (so ``--contracts-update`` regenerates them) and
+#: re-evaluated against the CURRENT fingerprints on every contract run:
+#:
+#: * ``dtypes_present``: the program's census must contain these dtypes —
+#:   for bf16 programs, "bf16" proves the compute cast survived lowering
+#:   and "f32" proves the pinned islands (gp-norm, loss accumulation,
+#:   BN statistics, master params / Adam moments held by the caller in
+#:   f32) were not swept into bf16;
+#: * ``max_collective_bytes_ratio``: total collective payload bytes must
+#:   be <= ratio * the named f32 twin program's total (same family,
+#:   same run) — the "~2x lower aggregation bytes" acceptance criterion.
+#:   Ratios carry headroom over the measured toy-program values: pure
+#:   parameter-payload programs land near 0.5, gated/robust ones higher
+#:   because the Byzantine gate's f32 scalar all_gathers (deliberately
+#:   NOT quantized) are a bigger share of the tiny toy payload.
+PROGRAM_REQUIREMENTS: Dict[str, Dict[str, dict]] = {
+    "train_federated": {
+        "fused_epoch[weighted@bf16]": {
+            "dtypes_present": ["bf16", "f32"],
+            "max_collective_bytes_ratio": {
+                "vs": "fused_epoch[weighted]", "ratio": 0.6},
+        },
+        "fused_epoch[gated@bf16]": {
+            "dtypes_present": ["bf16", "f32"],
+            "max_collective_bytes_ratio": {
+                "vs": "fused_epoch[gated]", "ratio": 0.65},
+        },
+    },
+    "parallel_fedavg": {
+        "fedavg[weighted_delta_bf16]": {
+            "dtypes_present": ["bf16", "f32"],
+            "max_collective_bytes_ratio": {
+                "vs": "fedavg[weighted_psum]", "ratio": 0.6},
+        },
+        **{f"robust_agg[{a}@bf16]": {
+            "dtypes_present": ["bf16", "f32"],
+            "max_collective_bytes_ratio": {
+                "vs": f"robust_agg[{a}]",
+                # psum aggregators: gate scalars dominate the toy payload
+                # (measured 0.81); gather aggregators ship the bulk leaves
+                # at bf16 (measured 0.58)
+                "ratio": 0.85 if a in ("weighted", "clipped") else 0.65},
+           } for a in ("weighted", "clipped", "trimmed", "median")},
+    },
+    "serve_engine": {
+        serve_bucket_name(n, c, "bf16"): {
+            "dtypes_present": ["bf16", "f32"],
+        }
         for n in (1, 4) for c in (False, True)
     },
 }

@@ -29,7 +29,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
-__all__ = ["Fingerprint", "fingerprint_text", "tensor_nbytes"]
+__all__ = ["Fingerprint", "fingerprint_text", "tensor_nbytes",
+           "total_collective_bytes"]
 
 #: bytes per element for the dtypes XLA emits; unknown dtypes count as 0
 #: bytes (they still show in the census, so a contract catches them).
@@ -106,6 +107,14 @@ class Fingerprint:
             transfers=dict(data.get("transfers", {})),
             dtypes=dict(data.get("dtypes", {})),
         )
+
+
+def total_collective_bytes(fp: "Fingerprint") -> int:
+    """Summed payload bytes over every collective op of one program — the
+    quantity the bf16 contracts' ``max_collective_bytes_ratio`` requirement
+    bounds against the f32 twin program."""
+    return sum(int(entry.get("bytes", 0))
+               for entry in fp.collectives.values())
 
 
 def _split_top_level(s: str) -> List[str]:

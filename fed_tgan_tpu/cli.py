@@ -88,11 +88,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "split-model (shared generator, local discriminators)")
     p.add_argument("--backend", type=str, default=None, choices=[None, "tpu", "cpu"],
                    help="cpu = virtual-device mesh (see --n-virtual-devices)")
-    p.add_argument("--bgm-backend", type=str, default="sklearn",
+    p.add_argument("--bgm-backend", type=str, default="jax",
                    choices=["sklearn", "jax"],
-                   help="per-column Bayesian-GMM fitter for init: sklearn = "
-                        "reference-exact estimator on host; jax = one vmapped "
-                        "variational-DP program on device (much faster init)")
+                   help="per-column Bayesian-GMM fitter for init: jax = one "
+                        "vmapped variational-DP program on device (default; "
+                        "much faster init, no per-column ConvergenceWarning "
+                        "flood); sklearn = reference-exact estimator on host")
+    p.add_argument("--precision", type=str, default="f32",
+                   choices=["f32", "bf16"],
+                   help="training/serving numerics: bf16 = matmuls and "
+                        "activations in bfloat16 with f32 islands (GP norm, "
+                        "Gumbel logits, loss reductions, BN statistics) and "
+                        "f32 master params/optimizer moments; halves the "
+                        "FedAvg aggregation payload.  f32 = reference-exact "
+                        "(default)")
     p.add_argument("--n-virtual-devices", type=int, default=8)
     p.add_argument("--batch-size", type=int, default=500)
     p.add_argument("--embedding-dim", type=int, default=128)
@@ -421,6 +430,7 @@ def _run_multihost_init(args) -> int:
                     gate_norm_factor=args.gate_norm_factor,
                     update_clip=args.update_clip,
                     trim_ratio=args.trim_ratio,
+                    precision=args.precision,
                 )
                 client_train(t, out, cfg, make_run())
                 print(f"rank {args.rank} training complete")
@@ -709,7 +719,8 @@ def main(argv=None) -> int:
                       update_gate=not args.no_update_gate,
                       gate_norm_factor=args.gate_norm_factor,
                       update_clip=args.update_clip,
-                      trim_ratio=args.trim_ratio)
+                      trim_ratio=args.trim_ratio,
+                      precision=args.precision)
     if args.mode == "standalone":
         # no participants, no harmonization/refit protocol — skip the
         # federated construction entirely

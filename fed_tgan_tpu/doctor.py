@@ -224,6 +224,75 @@ def check_program_contracts(timeout: int = 300) -> bool:
     return _line(True, "program-contracts", summary)
 
 
+def check_precision(timeout: int = 300) -> bool:
+    """The mixed-precision path lowers with the contracted dtype census.
+
+    A subprocess (lowering must own backend init, like the contract gate)
+    lowers the bf16 fused federated epoch next to its f32 twin and asserts
+    the three facts the bf16 mode is sold on: bf16 tensors actually appear,
+    the f32 islands (GP norm, loss reductions, BN stats, master params) are
+    still present, and the aggregation collectives move at most 0.6x the
+    f32 payload bytes.  Catches a silently-degraded policy (e.g. a cast
+    refactor that turns the whole program back to f32, or one that casts
+    the islands away) before a training run does."""
+    import json
+    import subprocess
+
+    code = (
+        "import json\n"
+        "from fed_tgan_tpu.analysis.contracts.harness import (\n"
+        "    ENTRYPOINT_FAMILIES, require_mesh)\n"
+        "from fed_tgan_tpu.analysis.contracts.ir import (\n"
+        "    fingerprint_text, total_collective_bytes)\n"
+        "require_mesh()\n"
+        "fams = ENTRYPOINT_FAMILIES['train_federated']\n"
+        "out = {}\n"
+        "for name in ('fused_epoch[weighted]', 'fused_epoch[weighted@bf16]'):\n"
+        "    low = fams[name]()\n"
+        "    fp = fingerprint_text(low if isinstance(low, str)\n"
+        "                          else low.as_text())\n"
+        "    out[name] = {'bf16': fp.dtypes.get('bf16', 0),\n"
+        "                 'f32': fp.dtypes.get('f32', 0),\n"
+        "                 'cbytes': total_collective_bytes(fp)}\n"
+        "print(json.dumps(out))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "precision", f"timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return _line(False, "precision", " | ".join(tail) or "lowering failed")
+    try:
+        census = json.loads(proc.stdout.strip().splitlines()[-1])
+        f32p = census["fused_epoch[weighted]"]
+        bf16p = census["fused_epoch[weighted@bf16]"]
+    except Exception as exc:
+        return _line(False, "precision", f"unparseable census: {exc!r}")
+    if bf16p["bf16"] <= 0:
+        return _line(False, "precision",
+                     "bf16 epoch lowered with NO bf16 tensors — the "
+                     "precision policy is not being applied")
+    if bf16p["f32"] <= 0:
+        return _line(False, "precision",
+                     "bf16 epoch lost its f32 islands (GP norm / loss "
+                     "reductions / BN stats / master params)")
+    if not bf16p["cbytes"] <= 0.6 * f32p["cbytes"]:
+        return _line(False, "precision",
+                     f"bf16 collectives move {bf16p['cbytes']}B vs f32 "
+                     f"{f32p['cbytes']}B — payload compression lost")
+    return _line(True, "precision",
+                 f"bf16 epoch: {bf16p['bf16']} bf16 + {bf16p['f32']} f32 "
+                 f"tensor sites, collective payload {bf16p['cbytes']}B "
+                 f"vs f32 {f32p['cbytes']}B "
+                 f"({bf16p['cbytes'] / max(1, f32p['cbytes']):.2f}x)")
+
+
 def check_robust_aggregation() -> bool:
     """Each robust aggregator rejects a poisoned client on a tiny pytree.
 
@@ -519,6 +588,7 @@ def main(argv=None) -> int:
         check_compile_cache(),
         check_static_analysis(),
         check_program_contracts(),
+        check_precision(),
         check_observability(),
         check_serving(),
     ]

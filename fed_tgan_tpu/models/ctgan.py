@@ -86,9 +86,16 @@ def generator_apply(
     new_blocks = []
     for block, bstate in zip(params["blocks"], state["blocks"]):
         h = _linear(block["fc"], x)
+        # batch-norm statistics are an f32 island under bf16 compute: the
+        # (h - mean) cancellation and the running-average update both die
+        # in bf16's 8 mantissa bits.  The running state pytree is passed
+        # in f32 (callers never cast it), so the aggregated BN state stays
+        # a full-precision master copy; same-dtype casts are no-ops in
+        # f32 mode, keeping that program byte-identical.
+        h32 = h.astype(jnp.float32)
         if train:
-            mean = h.mean(axis=0)
-            var = h.var(axis=0)  # biased, used for normalization
+            mean = h32.mean(axis=0)
+            var = h32.var(axis=0)  # biased, used for normalization
             n = h.shape[0]
             unbiased = var * n / max(n - 1, 1)
             new_blocks.append(
@@ -100,9 +107,9 @@ def generator_apply(
         else:
             mean, var = bstate["mean"], bstate["var"]
             new_blocks.append(bstate)
-        h = (h - mean) / jnp.sqrt(var + BN_EPS)
-        h = h * block["bn_scale"] + block["bn_bias"]
-        h = jax.nn.relu(h)
+        h32 = (h32 - mean) / jnp.sqrt(var + BN_EPS)
+        h32 = h32 * block["bn_scale"] + block["bn_bias"]
+        h = jax.nn.relu(h32).astype(h.dtype)
         x = jnp.concatenate([h, x], axis=1)
     out = _linear(params["out"], x)
     return out, {"blocks": new_blocks}

@@ -51,7 +51,16 @@ def gradient_penalty(
     just nested autodiff here.
     """
     alpha = jax.random.uniform(key, (real.shape[0], 1))
-    interp = slerp(alpha, real, fake)
+    # f32 islands under bf16 compute: slerp's arccos/sin chain and the
+    # grad-norm reduction both lose the (norm - 1) signal entirely in
+    # bf16's 8 mantissa bits, so they are pinned to f32; the D forward
+    # itself runs at the inputs' compute dtype (interp is cast back).
+    # Every cast is a same-dtype no-op in f32 mode.
+    interp = slerp(
+        alpha, real.astype(jnp.float32), fake.astype(jnp.float32)
+    ).astype(real.dtype)
     grads = jax.grad(lambda x: d_fn(x).sum())(interp)
-    norms = jnp.linalg.norm(grads.reshape(-1, pac * real.shape[1]), axis=1)
+    norms = jnp.linalg.norm(
+        grads.astype(jnp.float32).reshape(-1, pac * real.shape[1]), axis=1
+    )
     return ((norms - 1.0) ** 2).mean() * lambda_

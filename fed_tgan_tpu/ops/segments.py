@@ -105,11 +105,20 @@ def apply_activate_xla(data: jax.Array, spec: SegmentSpec, key: jax.Array) -> ja
     """tanh on scalar dims, gumbel-softmax (tau=0.2) on one-hot segments.
 
     Equivalent of reference ctgan.py:67-82 with F.gumbel_softmax semantics
-    (soft sample, no straight-through)."""
+    (soft sample, no straight-through).
+
+    The Gumbel logits are an f32 island under bf16 compute: tau=0.2 scales
+    logits by 5x and ``exp()`` of a bf16 difference collapses small
+    between-option gaps, so noise/softmax run in f32 and only the result
+    is cast back to the compute dtype (no-op casts in f32 mode — the
+    Pallas kernel pins the same island internally)."""
+    x = data.astype(jnp.float32)
     g = -jnp.log(-jnp.log(jax.random.uniform(key, data.shape) + 1e-20) + 1e-20)
-    noisy = (data + g) / GUMBEL_TAU
+    noisy = (x + g) / GUMBEL_TAU
     soft = _segment_softmax(noisy, spec.segment_ids, spec.n_segments)
-    return jnp.where(jnp.asarray(spec.is_tanh_dim), jnp.tanh(data), soft)
+    return jnp.where(
+        jnp.asarray(spec.is_tanh_dim), jnp.tanh(x), soft
+    ).astype(data.dtype)
 
 
 def apply_activate(data: jax.Array, spec: SegmentSpec, key: jax.Array) -> jax.Array:
@@ -140,7 +149,11 @@ def cond_loss(
 
     data: (batch, dim) raw generator output; cond_vec: (batch, n_opt);
     mask: (batch, n_discrete) — 1 for the column each row conditioned on.
+
+    The logsumexp / cross-entropy reduction is an f32 island under bf16
+    compute (the cast is a traced no-op for f32 inputs).
     """
+    data = data.astype(jnp.float32)
     logits = data[:, jnp.asarray(spec.discrete_dims)]  # (batch, n_opt)
     col_ids = spec.cond_column_ids
     m = jax.ops.segment_max(

@@ -33,6 +33,37 @@ def weighted_average(tree, weights: jax.Array, axis_name: str = CLIENTS_AXIS):
     return jax.tree.map(avg, tree)
 
 
+def weighted_delta_average(
+    prev,
+    new,
+    weights: jax.Array,
+    axis_name: str = CLIENTS_AXIS,
+    payload_dtype=jnp.bfloat16,
+):
+    """:func:`weighted_average` with the COLLECTIVE payload re-encoded to
+    ``payload_dtype`` — the bf16 half of the mixed-precision mode.
+
+    Only the weighted per-round DELTA crosses the wire at reduced
+    precision: the local weighted accumulation runs in f32, the psum moves
+    ``payload_dtype`` bytes (~half of f32), and the result is re-anchored
+    on the replicated global prev in f32.  Quantization error is therefore
+    confined to each round's step, never compounding in the master params.
+
+    Requires what the fused epoch already guarantees: ``prev`` replicated
+    (``leaf[0]`` is the global state) and the global ``weights`` summing
+    to 1 (so sum_i w_i * (n_i - p) == sum_i w_i * n_i - p).
+    """
+
+    def avg(p, n):
+        d = n.astype(jnp.float32) - p.astype(jnp.float32)
+        local = jnp.tensordot(weights, d, axes=1)
+        step = jax.lax.psum(local.astype(payload_dtype), axis_name)
+        return (p[0].astype(jnp.float32)
+                + step.astype(jnp.float32)).astype(n.dtype)
+
+    return jax.tree.map(avg, prev, new)
+
+
 def replicate_local(tree, k: int):
     """Broadcast averaged leaves back to the per-local-client layout."""
     return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (k,) + x.shape), tree)
@@ -87,6 +118,7 @@ def robust_aggregate(
     update_clip: float = 3.0,
     trim_ratio: float = 0.2,
     axis_name: str = CLIENTS_AXIS,
+    payload_dtype=None,
 ):
     """Gate + aggregate client parameter trees inside shard_map.
 
@@ -102,6 +134,12 @@ def robust_aggregate(
     ORIGINAL weights (scalar select, not a renormalized copy), so the
     ``weighted`` aggregator reproduces :func:`weighted_average`
     bit-identically on clean rounds.
+
+    ``payload_dtype`` (bf16 mode) re-encodes the cross-device payload of
+    every aggregator to that dtype, composing with the gate: the norm
+    screen's ``_delta_norms``/all_gather scalars stay f32 (a poisoned
+    update must not hide behind quantization), only the bulk parameter
+    traffic shrinks.  ``None`` keeps the f32 programs byte-identical.
     """
     gather = lambda x: jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
     rank = jax.lax.axis_index(axis_name)
@@ -154,7 +192,11 @@ def robust_aggregate(
     )
 
     if aggregator == "weighted":
-        agg = weighted_average(san, w_eff_l, axis_name)
+        if payload_dtype is not None:
+            agg = weighted_delta_average(
+                prev, san, w_eff_l, axis_name, payload_dtype)
+        else:
+            agg = weighted_average(san, w_eff_l, axis_name)
     elif aggregator == "clipped":
         # norm-clipped weighted mean of deltas around the global prev:
         # scale_i = min(1, update_clip * median_norm / norm_i)
@@ -167,7 +209,9 @@ def robust_aggregate(
         def clip_avg(p, n):
             d = n.astype(jnp.float32) - p.astype(jnp.float32)
             local = jnp.tensordot(cw_l, d, axes=1)
-            step = jax.lax.psum(local, axis_name)
+            if payload_dtype is not None:
+                local = local.astype(payload_dtype)
+            step = jax.lax.psum(local, axis_name).astype(jnp.float32)
             return (p[0].astype(jnp.float32) + step).astype(n.dtype)
 
         agg = jax.tree.map(clip_avg, prev, san)
@@ -179,7 +223,9 @@ def robust_aggregate(
         )
 
         def trim_mean(leaf):
-            g = gather(leaf.astype(jnp.float32))          # (n, ...)
+            src = (leaf.astype(payload_dtype) if payload_dtype is not None
+                   else leaf.astype(jnp.float32))
+            g = gather(src).astype(jnp.float32)           # (n, ...)
             n_total = g.shape[0]
             mask = valid.reshape((n_total,) + (1,) * (g.ndim - 1))
             g = jnp.where(mask, g, jnp.inf)               # invalid sort last
@@ -195,7 +241,9 @@ def robust_aggregate(
     elif aggregator == "median":
 
         def coord_median(leaf):
-            g = gather(leaf.astype(jnp.float32))
+            src = (leaf.astype(payload_dtype) if payload_dtype is not None
+                   else leaf.astype(jnp.float32))
+            g = gather(src).astype(jnp.float32)
             mask = valid.reshape((g.shape[0],) + (1,) * (g.ndim - 1))
             g = jnp.where(mask, g, jnp.nan)
             return jnp.nanmedian(g, axis=0).astype(leaf.dtype)

@@ -78,7 +78,7 @@ def backend_initialized() -> bool:
 
 
 def probe_backend_responsive(
-    timeout_s: int = 120,
+    timeout_s: int = 15,
     attempts: int = 1,
     backoff_s: float = 60.0,
     log=None,
@@ -91,6 +91,11 @@ def probe_backend_responsive(
     SUBPROCESS with a timeout lets callers fall back to a CPU mesh instead
     of hanging with it.  Only meaningful before this process initializes a
     backend.
+
+    The deadline is a hard ~15 s by default: a healthy backend answers in
+    low single-digit seconds, and BENCH_r05 measured a wedged tunnel
+    holding the old 120–300 s deadlines for their full duration on every
+    attempt — CPU failover should cost seconds, not minutes.
 
     Returns ``(ok, reason)`` — ``reason`` distinguishes a hang from a fast
     crash and carries the child's stderr tail so misconfigurations (e.g. a
@@ -163,12 +168,14 @@ def probe_backend_responsive(
             os.close(fd)
         except OSError:
             pass
-        _emit_event("backend_probe", ok=True, attempts=attempt)
+        _emit_event("backend_probe", ok=True, attempts=attempt,
+                    timeout_s=timeout_s)
         return True, "" if attempt == 1 else f"ok after {attempt} attempts"
     if attempts > 1:
         reason += f" (after {attempts} attempts over ~" \
-                  f"{(attempts * timeout_s + (attempts - 1) * backoff_s) / 60:.0f} min)"
-    _emit_event("backend_probe", ok=False, reason=reason)
+                  f"{attempts * timeout_s + (attempts - 1) * backoff_s:.0f}s)"
+    _emit_event("backend_probe", ok=False, reason=reason,
+                timeout_s=timeout_s)
     return False, reason
 
 

@@ -1,0 +1,86 @@
+"""Mixed-precision policy: bf16 compute with pinned f32 islands.
+
+One small frozen policy object, resolved from ``TrainConfig.precision``
+(``"f32"`` | ``"bf16"``), is consumed by every layer that does math:
+
+* the models cast parameters/inputs to the COMPUTE dtype at loss-function
+  entry, so matmuls (the MXU path) run in bf16 while ``jax.grad`` returns
+  f32 gradients automatically — the vjp of ``convert_element_type`` casts
+  cotangents back to the cast's input dtype, which keeps MASTER params and
+  Adam moments f32 with zero optimizer changes;
+* numerically fragile reductions stay f32 ISLANDS regardless of mode:
+  the WGAN-GP gradient-penalty norm (``models/losses.py``), loss mean
+  reductions and the conditional cross-entropy logits (``train/steps.py``,
+  ``ops/segments.py``), Gumbel-softmax logits (``ops/segments.py`` /
+  ``ops/activate_pallas.py``), batch-norm statistics (``models/ctgan.py``),
+  and the FedAvg accumulation (``parallel/fedavg.py``);
+* the aggregation payload that crosses the wire each round is re-encoded
+  to bf16 (``weighted_delta_average``) — roughly half the collective
+  bytes, contract-checked by ``analysis/contracts``.
+
+Every hook is a same-dtype ``astype`` in f32 mode: jax elides
+same-dtype ``convert_element_type`` at trace time, so f32-mode programs
+stay BYTE-IDENTICAL to pre-precision builds (the existing IR contracts
+prove this property on every run).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+PRECISIONS = ("f32", "bf16")
+
+
+@dataclass(frozen=True)
+class Precision:
+    """Resolved precision policy; construct via :func:`resolve_precision`."""
+
+    name: str  # "f32" | "bf16"
+
+    @property
+    def compute_dtype(self):
+        """dtype of matmuls / activations inside the loss functions."""
+        return jnp.bfloat16 if self.name == "bf16" else jnp.float32
+
+    @property
+    def param_dtype(self):
+        """Master parameters and optimizer moments are ALWAYS f32; the
+        compute cast happens inside the loss function, never on the
+        stored pytrees."""
+        return jnp.float32
+
+    def cast(self, tree):
+        """Cast every floating leaf of ``tree`` (a pytree or bare array)
+        to the compute dtype.  Identity in f32 mode — not merely cheap:
+        no convert op is even traced, so f32 programs keep their exact
+        pre-precision IR."""
+        if self.name == "f32":
+            return tree
+        dt = self.compute_dtype
+        return jax.tree.map(
+            lambda x: x.astype(dt)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            tree,
+        )
+
+    @property
+    def payload_dtype(self):
+        """dtype of the FedAvg collective payload (None = leave f32)."""
+        return jnp.bfloat16 if self.name == "bf16" else None
+
+
+def resolve_precision(name: str) -> Precision:
+    """Validate and freeze a precision selection."""
+    if name not in PRECISIONS:
+        raise ValueError(
+            f"unknown precision {name!r}; expected one of {PRECISIONS}")
+    return Precision(name)
+
+
+def f32_island(x):
+    """Pin ``x`` to f32 for a numerically fragile region (no-op on f32
+    input — same-dtype casts trace to nothing)."""
+    return x.astype(jnp.float32)

@@ -34,13 +34,17 @@ def demo_frame(rows: int = 200, seed: int = 0):
 
 def build_demo_artifact(out_dir: str, rows: int = 200, seed: int = 0,
                         epochs: int = 1, batch_size: int = 50,
-                        embedding_dim: int = 16, name: str = "demo") -> str:
+                        embedding_dim: int = 16, name: str = "demo",
+                        precision: str = "f32") -> str:
     """Train + persist the demo artifact under ``out_dir``; returns
     ``out_dir`` (resolvable by ``registry.resolve_artifact``).
 
     Mirrors the CLI standalone ``--save-model`` block: meta/encoders
     first, the synthesizer last, so the registry's meta-freshness check
-    sees the healthy ordering."""
+    sees the healthy ordering.  ``precision`` rides into the persisted
+    TrainConfig, so a served engine builds its bucket programs at the
+    model's training precision (bf16 buckets compile separately and are
+    contract-checked as ``serve_bucket_*_bf16``)."""
     from fed_tgan_tpu.data.encoders import encoder_artifact
     from fed_tgan_tpu.data.ingest import TablePreprocessor
     from fed_tgan_tpu.federation.init import harmonize_categories
@@ -57,7 +61,8 @@ def build_demo_artifact(out_dir: str, rows: int = 200, seed: int = 0,
     matrix, cat_idx, ord_idx = pre.encode(encoders)
 
     cfg = TrainConfig(batch_size=batch_size, embedding_dim=embedding_dim,
-                      gen_dims=(32, 32), dis_dims=(32, 32))
+                      gen_dims=(32, 32), dis_dims=(32, 32),
+                      precision=precision)
     synth = StandaloneSynthesizer(config=cfg, seed=seed)
     synth.fit(matrix, cat_idx, ord_idx, epochs=epochs)
 

@@ -59,8 +59,12 @@ def build_bucket_program(spec, cfg, decode_fn, n_steps: int,
 
     from fed_tgan_tpu.models.ctgan import generator_apply
     from fed_tgan_tpu.ops.segments import apply_activate
+    from fed_tgan_tpu.runtime.precision import resolve_precision
 
     B, emb = cfg.batch_size, cfg.embedding_dim
+    # getattr: cfg may be a pre-precision TrainConfig restored from an old
+    # saved model artifact — those trained (and serve) in f32
+    pol = resolve_precision(getattr(cfg, "precision", "f32"))
 
     def run(params_g, state_g, cond, key, start, pos):
         # one step == make_sample_step's draw exactly (kz/kc/ka split
@@ -79,20 +83,23 @@ def build_bucket_program(spec, cfg, decode_fn, n_steps: int,
                 else:
                     c = cond.sample_empirical(kc, B)
                 z = jnp.concatenate([z, c], axis=1)
-            raw, _ = generator_apply(params_g, state_g, z, train=False)
+            raw, _ = generator_apply(
+                pol.cast(params_g), state_g, pol.cast(z), train=False)
             return apply_activate(raw, spec, ka)
 
         def body(carry, i):
             return carry, single(jax.random.fold_in(key, start + i))
 
         _, out = jax.lax.scan(body, None, jnp.arange(n_steps))
-        flat = out.reshape(n_steps * B, -1)
+        # decode (quantile inverse transform) is an f32 island under bf16;
+        # the cast is a traced no-op in f32 mode
+        flat = out.reshape(n_steps * B, -1).astype(jnp.float32)
         return decode_fn(flat) if decode_fn is not None else flat
 
     # distinct compiled-program name per bucket, so the sanitizer compile
     # counter can assert "<= one compile per bucket" and the contracts
     # can key the fingerprint
-    run.__name__ = serve_bucket_name(n_steps, conditional)
+    run.__name__ = serve_bucket_name(n_steps, conditional, pol.name)
     run.__qualname__ = run.__name__
     return run
 

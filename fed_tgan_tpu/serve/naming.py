@@ -19,6 +19,14 @@ from __future__ import annotations
 SERVE_BUCKET_PREFIX = "serve_bucket_"
 
 
-def serve_bucket_name(n_steps: int, conditional: bool) -> str:
-    """Program name for the (power-of-two step bucket, conditional?) pair."""
-    return f"{SERVE_BUCKET_PREFIX}{int(n_steps)}{'_cond' if conditional else ''}"
+def serve_bucket_name(n_steps: int, conditional: bool,
+                      precision: str = "f32") -> str:
+    """Program name for the (power-of-two step bucket, conditional?) pair.
+
+    ``precision`` suffixes non-f32 buckets (``_bf16``): a model trained
+    under mixed precision serves through DIFFERENT programs than an f32
+    one, and the contracts/compile-budget must see them as such.  f32
+    names are unchanged from pre-precision builds."""
+    suffix = "" if precision == "f32" else f"_{precision}"
+    return (f"{SERVE_BUCKET_PREFIX}{int(n_steps)}"
+            f"{'_cond' if conditional else ''}{suffix}")

@@ -38,6 +38,7 @@ from fed_tgan_tpu.parallel.fedavg import (
     replicate_local,
     robust_aggregate,
     weighted_average,
+    weighted_delta_average,
 )
 from fed_tgan_tpu.parallel.mesh import (
     CLIENTS_AXIS,
@@ -217,6 +218,10 @@ def make_federated_epoch(
     # for-byte unchanged for cache hits
     use_robust = (cfg.update_gate or cfg.aggregator != "weighted"
                   or update_fault is not None)
+    # bf16 mode ships only the weighted per-round delta over the wire at
+    # half width (parallel/fedavg.py); None keeps every f32 aggregation
+    # program byte-identical to pre-precision builds
+    payload_dtype = (jnp.bfloat16 if cfg.precision == "bf16" else None)
 
     def epoch_local(models, data, cond, rows, steps_i, weight, key, *ema_in):
         avg = partial(weighted_average, weights=weight)
@@ -265,9 +270,18 @@ def make_federated_epoch(
                     gate_norm_factor=cfg.gate_norm_factor,
                     update_clip=cfg.update_clip,
                     trim_ratio=cfg.trim_ratio,
+                    payload_dtype=payload_dtype,
                 )
                 metrics = dict(metrics)
                 metrics["quarantined"] = quar
+            elif payload_dtype is not None:
+                davg = partial(weighted_delta_average, weights=weight,
+                               payload_dtype=payload_dtype)
+                prev_g, prev_d, prev_sg = prev_agg
+                new_g, new_d, new_sg = new_agg
+                avg_g, avg_d, avg_sg = (
+                    davg(prev_g, new_g), davg(prev_d, new_d),
+                    davg(prev_sg, new_sg))
             else:
                 new_g, new_d, new_sg = new_agg
                 avg_g, avg_d, avg_sg = avg(new_g), avg(new_d), avg(new_sg)

@@ -32,6 +32,7 @@ from fed_tgan_tpu.models.ctgan import (
 )
 from fed_tgan_tpu.models.losses import gradient_penalty
 from fed_tgan_tpu.ops.segments import SegmentSpec, apply_activate, cond_loss
+from fed_tgan_tpu.runtime.precision import resolve_precision
 from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
 
 
@@ -87,6 +88,14 @@ class TrainConfig:
     gate_norm_factor: float = 10.0   # two-sided median-ratio threshold
     update_clip: float = 3.0         # delta-norm cap (x median), clipped agg
     trim_ratio: float = 0.2          # per-side fraction, trimmed agg
+    # Mixed precision (runtime/precision.py): "bf16" casts params/inputs to
+    # bf16 at loss-function entry (MXU-width matmuls, half-size aggregation
+    # payloads) while master params, Adam moments, and the named f32
+    # islands stay f32.  "f32" is the reference trajectory, byte-identical
+    # to pre-precision builds (same-dtype casts trace to nothing), and —
+    # being the default — never enters config_signature, so existing
+    # checkpoints stay valid by construction.
+    precision: str = "f32"           # f32 | bf16
 
 
 def lr_decay_horizon(lr_schedule: str, epochs: int, max_shard_rows: int,
@@ -248,6 +257,13 @@ def make_train_step(spec: SegmentSpec, cfg: TrainConfig):
     opt_g, opt_d = make_optimizers(cfg)
     B = cfg.batch_size
     has_cond = spec.n_discrete > 0
+    # Mixed precision: params/inputs are cast to the compute dtype INSIDE
+    # the loss functions, so jax.grad returns f32 gradients (the vjp of the
+    # cast converts cotangents back) and the stored master params + Adam
+    # moments stay f32 with the optimizer chain untouched.  The BN state
+    # pytree is passed UNCAST — its statistics are an f32 island.  All
+    # casts are traced no-ops in f32 mode.
+    pol = resolve_precision(cfg.precision)
 
     def step(models: ModelBundle, data, cond: CondSampler, rows: RowSampler, key):
         keys = jax.random.split(key, 13)
@@ -270,21 +286,26 @@ def make_train_step(spec: SegmentSpec, cfg: TrainConfig):
             real = data[row_idx]
 
             fake_raw, state_g2 = generator_apply(
-                models.params_g, state_g, gen_in, train=True)
+                pol.cast(models.params_g), state_g, pol.cast(gen_in),
+                train=True)
             fake_act = apply_activate(fake_raw, spec, dk[4])
             if has_cond:
-                fake_cat = jnp.concatenate([fake_act, c1], axis=1)
-                real_cat = jnp.concatenate([real, c2], axis=1)
+                fake_cat = jnp.concatenate(
+                    [fake_act, c1.astype(fake_act.dtype)], axis=1)
+                real_cat = pol.cast(jnp.concatenate([real, c2], axis=1))
             else:
-                fake_cat, real_cat = fake_act, real
+                fake_cat, real_cat = fake_act, pol.cast(real)
             fake_cat = jax.lax.stop_gradient(fake_cat)
 
             def d_loss_fn(params_d):
-                y_fake = discriminator_apply(params_d, fake_cat, dk[5], cfg.pac)
-                y_real = discriminator_apply(params_d, real_cat, dk[6], cfg.pac)
-                loss_d = jnp.mean(y_fake) - jnp.mean(y_real)
+                pd = pol.cast(params_d)
+                y_fake = discriminator_apply(pd, fake_cat, dk[5], cfg.pac)
+                y_real = discriminator_apply(pd, real_cat, dk[6], cfg.pac)
+                # loss reductions are f32 islands
+                loss_d = (jnp.mean(y_fake.astype(jnp.float32))
+                          - jnp.mean(y_real.astype(jnp.float32)))
                 pen = gradient_penalty(
-                    lambda x: discriminator_apply(params_d, x, dk[7], cfg.pac),
+                    lambda x: discriminator_apply(pd, x, dk[7], cfg.pac),
                     real_cat,
                     fake_cat,
                     dk[8],
@@ -322,12 +343,15 @@ def make_train_step(spec: SegmentSpec, cfg: TrainConfig):
             gen_in2 = z2
 
         def g_loss_fn(params_g):
-            raw, state_g3 = generator_apply(params_g, state_g2, gen_in2, train=True)
+            raw, state_g3 = generator_apply(
+                pol.cast(params_g), state_g2, pol.cast(gen_in2), train=True)
             act = apply_activate(raw, spec, keys[11])
-            d_in = jnp.concatenate([act, c1g], axis=1) if has_cond else act
-            y_fake = discriminator_apply(params_d, d_in, keys[12], cfg.pac)
+            d_in = (jnp.concatenate([act, c1g.astype(act.dtype)], axis=1)
+                    if has_cond else act)
+            y_fake = discriminator_apply(
+                pol.cast(params_d), d_in, keys[12], cfg.pac)
             ce = cond_loss(raw, spec, c1g, m1g) if has_cond else 0.0
-            return -jnp.mean(y_fake) + ce, state_g3
+            return -jnp.mean(y_fake.astype(jnp.float32)) + ce, state_g3
 
         (loss_g, state_g3), grads_g = jax.value_and_grad(g_loss_fn, has_aux=True)(
             models.params_g
@@ -367,7 +391,11 @@ def make_sample_step(spec: SegmentSpec, cfg: TrainConfig):
     """One generation step: (params_g, state_g, cond_sampler, key) -> batch.
 
     Uses eval-mode BN (running stats) like the reference's
-    ``generator.eval()`` sampling (Server/dtds/distributed.py:160-181)."""
+    ``generator.eval()`` sampling (Server/dtds/distributed.py:160-181).
+    Under bf16 the generator forward runs at the compute dtype but the
+    returned batch is f32 — decode (quantile/inverse transforms) is an
+    f32 island; the cast is a traced no-op in f32 mode."""
+    pol = resolve_precision(cfg.precision)
 
     def sample(params_g, state_g, cond: CondSampler, key):
         kz, kc, ka = jax.random.split(key, 3)
@@ -375,8 +403,9 @@ def make_sample_step(spec: SegmentSpec, cfg: TrainConfig):
         if spec.n_discrete > 0:
             c = cond.sample_empirical(kc, cfg.batch_size)
             z = jnp.concatenate([z, c], axis=1)
-        raw, _ = generator_apply(params_g, state_g, z, train=False)
-        return apply_activate(raw, spec, ka)
+        raw, _ = generator_apply(
+            pol.cast(params_g), state_g, pol.cast(z), train=False)
+        return apply_activate(raw, spec, ka).astype(jnp.float32)
 
     return sample
 

@@ -1,0 +1,281 @@
+"""Mixed-precision (bf16) mode: the policy object, the reduced-precision
+aggregation payload, the contract layer's ``require`` blocks (dtype census
++ payload-ratio), and seeded bf16-vs-f32 training parity with the f32
+islands asserted from the lowered IR."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.analysis.contracts.check import (
+    REGRESSION,
+    check_requirements,
+)
+from fed_tgan_tpu.analysis.contracts.ir import (
+    Fingerprint,
+    fingerprint_text,
+    tensor_nbytes,
+    total_collective_bytes,
+)
+from fed_tgan_tpu.ops.segments import SegmentSpec
+from fed_tgan_tpu.runtime.precision import PRECISIONS, resolve_precision
+from fed_tgan_tpu.train.steps import (
+    TrainConfig,
+    init_models,
+    make_sample_step,
+    make_train_step,
+)
+
+pytestmark = pytest.mark.precision
+
+OUT_INFO = [(1, "tanh"), (3, "softmax"), (1, "tanh"), (4, "softmax")]
+
+
+# ------------------------------------------------------------ ir tallies
+
+def test_tensor_nbytes_reduced_precision():
+    # the byte ledger the payload-ratio requirement is built on: bf16/f16
+    # are half of f32, fp8 a quarter
+    assert tensor_nbytes("8", "bf16") == 16
+    assert tensor_nbytes("8", "f16") == 16
+    assert tensor_nbytes("8", "f32") == 32
+    assert tensor_nbytes("2x4", "f8E4M3FN") == 8
+
+
+def test_fingerprint_bf16_collective_and_census():
+    text = (
+        "module @jit_prog {\n"
+        "  func.func public @main(%arg0: tensor<8xbf16>)"
+        " -> (tensor<8xbf16>) {\n"
+        '    %1 = "stablehlo.all_reduce"(%arg0) ({\n'
+        "    ^bb0(%a: tensor<bf16>, %b: tensor<bf16>):\n"
+        "      %s = stablehlo.add %a, %b : tensor<bf16>\n"
+        "      stablehlo.return %s : tensor<bf16>\n"
+        "    }) : (tensor<8xbf16>) -> tensor<8xbf16>\n"
+        "    %2 = stablehlo.convert %1 : (tensor<8xbf16>)"
+        " -> tensor<8xf32>\n"
+        "    return %1 : tensor<8xbf16>\n"
+        "  }\n"
+        "}\n"
+    )
+    fp = fingerprint_text(text)
+    assert fp.collectives["all_reduce"] == {"count": 1, "bytes": 16}
+    assert total_collective_bytes(fp) == 16
+    assert fp.dtypes["bf16"] >= 4 and fp.dtypes["f32"] >= 1
+
+
+# ------------------------------------------------------ require blocks
+
+def _fp(dtypes, cbytes):
+    fp = Fingerprint()
+    fp.dtypes = dict(dtypes)
+    fp.collectives = {"all_reduce": {"count": 1, "bytes": cbytes}}
+    return fp
+
+
+def test_require_dtypes_present():
+    programs = {"p[bf16]": _fp({"bf16": 10, "f32": 5}, 100)}
+    req = {"dtypes_present": ["bf16", "f32"]}
+    assert check_requirements("fam", "p[bf16]", req, programs) == []
+    # a cast refactor that silently turns the program back to pure f32
+    # must read as a REGRESSION, not a benign drift
+    programs["p[bf16]"] = _fp({"f32": 15}, 100)
+    issues = check_requirements("fam", "p[bf16]", req, programs)
+    assert [i.severity for i in issues] == [REGRESSION]
+    assert "bf16" in issues[0].metric
+
+
+def test_require_payload_ratio():
+    req = {"max_collective_bytes_ratio": {"vs": "p[f32]", "ratio": 0.6}}
+    programs = {"p[f32]": _fp({"f32": 10}, 200),
+                "p[bf16]": _fp({"bf16": 10, "f32": 2}, 100)}
+    assert check_requirements("fam", "p[bf16]", req, programs) == []
+    # payload advantage lost: bf16 program moving > 0.6x the f32 bytes
+    programs["p[bf16]"] = _fp({"bf16": 10, "f32": 2}, 150)
+    issues = check_requirements("fam", "p[bf16]", req, programs)
+    assert [i.severity for i in issues] == [REGRESSION]
+    # baseline program vanished: the ratio is unevaluable -> REGRESSION
+    issues = check_requirements(
+        "fam", "p[bf16]", req, {"p[bf16]": _fp({"bf16": 1}, 1)})
+    assert [i.severity for i in issues] == [REGRESSION]
+
+
+def test_require_blocks_attached_and_enforced(tmp_path):
+    """save_contracts writes the code-side registry's require block into
+    the JSON, and diff_contracts evaluates it on the CURRENT fingerprints
+    (absolute property, not an old-vs-new ratchet)."""
+    from unittest import mock
+
+    from fed_tgan_tpu.analysis.contracts import check as check_mod
+
+    reqs = {"fam": {"p[bf16]": {"dtypes_present": ["bf16"]}}}
+    current = {"fam": {"p[bf16]": _fp({"bf16": 3, "f32": 1}, 8),
+                       "p[f32]": _fp({"f32": 4}, 16)}}
+    with mock.patch.object(check_mod, "PROGRAM_REQUIREMENTS", reqs):
+        check_mod.save_contracts(current, contracts_dir=tmp_path)
+    stored = check_mod.load_contracts(["fam"], contracts_dir=tmp_path)
+    assert stored["fam"]["programs"]["p[bf16]"]["require"] == \
+        reqs["fam"]["p[bf16]"]
+    assert "require" not in stored["fam"]["programs"]["p[f32]"]
+    # clean: census satisfies the requirement
+    assert not [i for i in check_mod.diff_contracts(current, stored)
+                if i.severity == REGRESSION]
+    # the bf16 census evaporates -> the require block fires
+    current["fam"]["p[bf16]"] = _fp({"f32": 4}, 8)
+    bad = [i for i in check_mod.diff_contracts(current, stored)
+           if i.severity == REGRESSION]
+    assert any("dtypes_present.bf16" in i.metric for i in bad)
+
+
+# ------------------------------------------------------- policy object
+
+def test_resolve_precision_policy():
+    assert PRECISIONS == ("f32", "bf16")
+    f32 = resolve_precision("f32")
+    tree = {"w": jnp.ones((2, 2)), "n": jnp.arange(3)}
+    assert f32.cast(tree) is tree  # identity: no convert even traced
+    assert f32.payload_dtype is None
+
+    bf16 = resolve_precision("bf16")
+    out = bf16.cast(tree)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["n"].dtype == tree["n"].dtype  # non-float leaves untouched
+    assert bf16.param_dtype == jnp.float32  # master params stay f32
+    assert bf16.payload_dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="precision"):
+        resolve_precision("f16")
+
+
+# ------------------------------------------------- aggregation payload
+
+def test_weighted_delta_average_matches_weighted_average():
+    """The delta-encoded aggregator is the SAME math as weighted_average
+    when the payload stays f32, and stays close under a bf16 payload —
+    with the quantization confined to one round's step."""
+    from fed_tgan_tpu.parallel.fedavg import (
+        weighted_average,
+        weighted_delta_average,
+    )
+    from fed_tgan_tpu.parallel.mesh import client_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = 8
+    mesh = client_mesh(n)
+    rng = np.random.default_rng(0)
+    prev_g = rng.normal(size=(5, 3)).astype(np.float32)
+    prev = jnp.asarray(np.broadcast_to(prev_g, (n, 5, 3)))
+    new = prev + jnp.asarray(
+        0.01 * rng.normal(size=(n, 5, 3)).astype(np.float32))
+    w = jnp.asarray((rng.uniform(0.5, 1.5, n) /
+                     rng.uniform(0.5, 1.5, n).sum()).astype(np.float32))
+    w = w / w.sum()
+
+    def run(fn):
+        return jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P("clients"), P("clients"),
+                                     P("clients")),
+            out_specs=P(), check_vma=False))(prev, new, w)
+
+    want = run(lambda p, nw, wt: weighted_average(nw, wt))
+    exact = run(lambda p, nw, wt: weighted_delta_average(
+        p, nw, wt, payload_dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(exact), np.asarray(want),
+                               rtol=0, atol=1e-6)
+    quant = run(lambda p, nw, wt: weighted_delta_average(
+        p, nw, wt, payload_dtype=jnp.bfloat16))
+    # bf16 has ~3 decimal digits; the error budget is the DELTA's scale
+    # (0.01), not the params' scale — the re-anchoring on f32 prev is
+    # what keeps it there
+    assert np.abs(np.asarray(quant) - np.asarray(want)).max() < 1e-3
+
+
+# ------------------------------------------------ training-step parity
+
+def _toy_inputs(spec, cfg, seed=0):
+    from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
+
+    rng = np.random.default_rng(seed)
+    rows = 64
+    data = np.zeros((rows, spec.dim), np.float32)
+    col = 0
+    for width, act in OUT_INFO:
+        if act == "tanh":
+            data[:, col] = rng.uniform(-0.9, 0.9, rows)
+        else:
+            data[np.arange(rows), col + rng.integers(0, width, rows)] = 1.0
+        col += width
+    cond = CondSampler.from_data(data, spec)
+    rsamp = RowSampler.from_data(data, spec)
+    return jnp.asarray(data), cond, rsamp
+
+
+def _run_steps(precision, n_steps=6):
+    spec = SegmentSpec.from_output_info(OUT_INFO)
+    cfg = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                      batch_size=8, pac=2, precision=precision)
+    data, cond, rsamp = _toy_inputs(spec, cfg)
+    models = init_models(jax.random.key(5), spec, cfg)
+    step = jax.jit(make_train_step(spec, cfg))
+    losses = []
+    for i in range(n_steps):
+        models, met = step(models, data, cond, rsamp, jax.random.key(i))
+        losses.append(float(met["loss_g"]))
+    return spec, cfg, models, losses
+
+
+def test_bf16_vs_f32_seeded_trajectory_parity():
+    """Same seeds, same data: the bf16 loss trajectory must track f32
+    within a small tolerance, and the MASTER state (params + Adam
+    moments) must remain f32 — the grad-dtype trick keeps the optimizer
+    untouched."""
+    _, _, m32, l32 = _run_steps("f32")
+    _, _, m16, l16 = _run_steps("bf16")
+    assert all(np.isfinite(l32)) and all(np.isfinite(l16))
+    np.testing.assert_allclose(l16, l32, rtol=0, atol=0.05)
+    for leaf in jax.tree.leaves((m16.params_g, m16.params_d)):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree.leaves((m16.opt_g, m16.opt_d)):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+
+
+def test_bf16_step_ir_has_bf16_compute_and_f32_islands():
+    """The lowered bf16 train step's dtype census: bf16 compute present,
+    f32 islands present; the f32 step lowers with NO bf16 at all."""
+    spec = SegmentSpec.from_output_info(OUT_INFO)
+    data, cond, rsamp = _toy_inputs(spec, TrainConfig())
+    census = {}
+    for precision in PRECISIONS:
+        cfg = TrainConfig(embedding_dim=8, gen_dims=(16, 16),
+                          dis_dims=(16, 16), batch_size=8, pac=2,
+                          precision=precision)
+        models = init_models(jax.random.key(5), spec, cfg)
+        low = jax.jit(make_train_step(spec, cfg)).lower(
+            models, data, cond, rsamp, jax.random.key(0))
+        census[precision] = fingerprint_text(low.as_text()).dtypes
+    assert census["f32"].get("bf16", 0) == 0
+    assert census["bf16"].get("bf16", 0) > 0
+    assert census["bf16"].get("f32", 0) > 0  # the islands
+
+
+def test_bf16_sample_step_decodes_f32():
+    """Generation under bf16 returns an f32 batch: decode (quantile /
+    inverse transforms) is an f32 island."""
+    spec = SegmentSpec.from_output_info(OUT_INFO)
+    cfg = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                      batch_size=8, pac=2, precision="bf16")
+    _, cond, _ = _toy_inputs(spec, cfg)
+    models = init_models(jax.random.key(5), spec, cfg)
+    out = jax.jit(make_sample_step(spec, cfg))(
+        models.params_g, models.state_g, cond, jax.random.key(1))
+    assert out.dtype == jnp.float32
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_serve_bucket_name_precision_suffix():
+    from fed_tgan_tpu.serve.naming import serve_bucket_name
+
+    assert serve_bucket_name(4, False) == "serve_bucket_4"
+    assert serve_bucket_name(4, True, "f32") == "serve_bucket_4_cond"
+    assert serve_bucket_name(4, True, "bf16") == "serve_bucket_4_cond_bf16"
