@@ -368,7 +368,8 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
 def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
                 profile_dir: str | None = None,
                 obs_dir: str | None = "bench_obs_round",
-                precision: str = "f32") -> dict:
+                precision: str = "f32",
+                rounds_per_program: int = 1) -> dict:
     """Seconds per round of the real server loop: every round runs the
     clients' local steps + weighted FedAvg and snapshots 40k rows to a CSV,
     exactly like the reference server (distributed.py:785-829).  The
@@ -389,6 +390,14 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
     The host-phase attribution table from the spans rides along in the
     returned dict — this subsumes scripts/trace_attribution.py's
     collection side for the host half of the story.
+
+    ``rounds_per_program`` = K > 1 fuses K rounds (local epochs +
+    in-graph FedAvg) into one ``fused_rounds[K]`` device program — one
+    dispatch and one host round trip per K rounds.  The snapshot cadence
+    widens with it (snapshots land at program boundaries, like the CLI's
+    ``--rounds-per-program`` with a matching ``--sample-every``), so the
+    metric name carries an ``(rppK)`` tag; ``rounds`` is rounded up to a
+    whole number of programs.
     """
     import contextlib
     import tempfile
@@ -418,22 +427,44 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
                 trace = device_trace(profile_dir)
             else:
                 trace = contextlib.nullcontext()
+            K = max(1, int(rounds_per_program))
+            rounds = ((rounds + K - 1) // K) * K  # whole programs only
+
+            def fused_fit(n):
+                s0 = trainer.completed_epochs
+                trainer.fit(n, sample_hook=writer,
+                            hook_epochs=[s0 + i for i in range(n)
+                                         if (i + 1) % K == 0],
+                            max_rounds_per_call=K)
+
             with writer:
-                # warmup: compiles the rounds=1 epoch program + sample/decode
-                # programs and touches the whole transfer/decode/write path
-                trainer.fit(2, sample_hook=writer)
+                if K == 1:
+                    # warmup: compiles the rounds=1 epoch program +
+                    # sample/decode programs and touches the whole
+                    # transfer/decode/write path
+                    trainer.fit(2, sample_hook=writer)
+                else:
+                    # warmup: compiles the fused_rounds[K] program + the
+                    # sample/decode path (snapshot at the chunk end)
+                    fused_fit(K)
                 writer.drain()
                 with trace:
                     t0 = time.time()
-                    trainer.fit(rounds, sample_hook=writer)
+                    if K == 1:
+                        trainer.fit(rounds, sample_hook=writer)
+                    else:
+                        fused_fit(rounds)
                     writer.drain()
                     value = (time.time() - t0) / rounds
         result = {
             "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)"
-                      + ("" if precision == "f32" else f"({precision})"),
+                      + ("" if precision == "f32" else f"({precision})")
+                      + ("" if K == 1 else f"(rpp{K})"),
             "value": round(value, 4),
             "unit": "s/round",
             "vs_baseline": round(BASELINE_EPOCH_SECONDS / value, 2),
+            "rounds": rounds,
+            "rounds_per_program": K,
         }
         if obs_dir:
             trace_path = tracer.export(os.path.join(obs_dir, "trace.json"))
@@ -1309,6 +1340,14 @@ def main() -> int:
                          "rounds between snapshots fuse into single device "
                          "programs, so a sparse run fits a short healthy-"
                          "tunnel window with the trajectory unchanged")
+    ap.add_argument("--rounds-per-program", type=int, default=1,
+                    metavar="K",
+                    help="round workload: fuse K rounds (local epochs + "
+                         "in-graph FedAvg) into one lax.scan-over-rounds "
+                         "device program — one dispatch and one host round "
+                         "trip per K rounds, snapshots at program "
+                         "boundaries (metric gains an (rppK) tag); 1 = "
+                         "the reference every-round protocol (default)")
     ap.add_argument("--csv", type=str, default=None, metavar="PATH",
                     help="Intrusion CSV path (default: env FED_TGAN_BENCH_CSV "
                          f"or {CSV_PATH})")
@@ -1379,6 +1418,12 @@ def main() -> int:
                  f"--workload utility (got {args.workload})")
     if args.gan_seed != 0 and args.workload not in ("utility", "adult"):
         ap.error("--gan-seed only applies to the utility/adult workloads")
+    if args.rounds_per_program < 1:
+        ap.error(f"--rounds-per-program {args.rounds_per_program}: must "
+                 "be >= 1")
+    if args.rounds_per_program != 1 and args.workload != "round":
+        ap.error("--rounds-per-program only applies to --workload round "
+                 f"(got {args.workload})")
     if not 0.0 <= args.ema_decay < 1.0:
         ap.error(f"--ema-decay {args.ema_decay}: must be in [0, 1)")
     if args.ema_decay > 0 and args.select != "none":
@@ -1524,7 +1569,8 @@ def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
         return bench_round(bgm_backend=bgm,
                            profile_dir=args.profile_dir,
                            obs_dir=args.obs_dir or None,
-                           precision=args.precision)
+                           precision=args.precision,
+                           rounds_per_program=args.rounds_per_program)
     if args.workload == "utility":
         return bench_utility(
             epochs, n_clients=clients, weighted=not args.uniform,

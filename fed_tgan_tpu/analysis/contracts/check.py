@@ -236,7 +236,14 @@ def check_requirements(family: str, program: str, require: dict,
     * ``max_collective_bytes_ratio {vs, ratio}``: total collective bytes
       must stay <= ratio * the named sibling program's total — the
       "~2x lower aggregation payload" criterion, immune to both programs
-      drifting together.
+      drifting together;
+    * ``collective_bytes_scale {vs, rounds}``: IR collective bytes must
+      EQUAL the named single-round sibling's.  Collectives inside the
+      round scan lower once regardless of length, so equality is exactly
+      the statement "logical collective traffic scales ``rounds`` × the
+      single-round program": IR totals growing means the scan unrolled
+      into per-round collectives, any other delta means the per-round
+      aggregation payload re-widened.
     """
     issues: List[Issue] = []
     fp = programs[program]
@@ -269,6 +276,30 @@ def check_requirements(family: str, program: str, require: dict,
                     message="reduced-precision program lost its "
                             "collective-payload advantage over the f32 "
                             "twin"))
+    scale_req = require.get("collective_bytes_scale")
+    if scale_req:
+        vs, k_rounds = scale_req["vs"], int(scale_req["rounds"])
+        if vs not in programs:
+            issues.append(Issue(
+                severity=REGRESSION, family=family, program=program,
+                metric="require.collective_bytes_scale",
+                old=vs, new="missing",
+                message="single-round baseline for the scan-over-rounds "
+                        "requirement is no longer lowered"))
+        else:
+            mine = total_collective_bytes(fp)
+            base = total_collective_bytes(programs[vs])
+            if mine != base:
+                hint = ("round scan unrolled into per-round collectives?"
+                        if base and mine >= k_rounds * base
+                        else "per-round aggregation payload re-widened?")
+                issues.append(Issue(
+                    severity=REGRESSION, family=family, program=program,
+                    metric="require.collective_bytes_scale",
+                    old=f"== {base} ({vs})", new=mine,
+                    message=f"IR collective bytes must equal the single-"
+                            f"round program so logical traffic scales "
+                            f"exactly {k_rounds}x ({hint})"))
     return issues
 
 
@@ -316,6 +347,7 @@ def diff_contracts(current: Dict[str, Dict[str, Fingerprint]],
 #: candidate source sites of a regression.
 _FAMILY_DIRS = {
     "train_federated": ("train", "parallel", "ops", "models"),
+    "fused_rounds": ("train", "parallel", "ops", "models"),
     "parallel_fedavg": ("parallel",),
     "serve_engine": ("serve", "ops", "models"),
 }

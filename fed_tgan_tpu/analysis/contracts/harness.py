@@ -185,6 +185,37 @@ def _lower_epoch(variant: str):
     return fn.lower(*args)
 
 
+def _lower_fused_rounds(k_rounds: int, precision: str = "f32"):
+    """The scan-over-rounds training program at fusion width K — the
+    exact builder the trainer's ``--rounds-per-program K`` path compiles
+    (``make_federated_epoch`` with ``rounds=K``), on the production
+    default (gated) config or its bf16 twin.
+
+    Collectives inside the round scan appear ONCE in the lowered IR
+    regardless of K, so a correctly fused program's collective totals are
+    byte-identical to ``fused_rounds[1]`` while its LOGICAL traffic
+    scales exactly K×.  The ``collective_bytes_scale`` require block
+    below pins that equality: IR totals growing toward K× the baseline
+    means the scan unrolled into per-round collectives; any other delta
+    means the per-round aggregation payload re-widened."""
+    import jax
+
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.train.federated import make_federated_epoch
+
+    require_mesh()
+    spec = _toy_spec()
+    cfg = _toy_cfg(**({} if precision == "f32"
+                      else {"precision": "bf16"}))
+    mesh = client_mesh(N_DEVICES)
+    data, cond, rows, steps, weights = _client_stacks(spec, cfg)
+    _one, models = _stacked_models(spec, cfg)
+    fn = make_federated_epoch(spec, cfg, max_steps=int(steps.max()),
+                              mesh=mesh, k=1, rounds=k_rounds)
+    return fn.lower(models, data, cond, rows, steps, weights,
+                    jax.random.key(0))
+
+
 def _agg_trees():
     """A two-leaf pytree with the (n_clients, k, ...) layout
     robust_aggregate sees inside the fused epoch."""
@@ -304,6 +335,13 @@ ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
         f"fused_epoch[{v}]": (lambda v=v: _lower_epoch(v))
         for v in _EPOCH_VARIANTS
     },
+    "fused_rounds": {
+        **{f"fused_rounds[{k}]": (lambda k=k: _lower_fused_rounds(k))
+           for k in (1, 2, 4)},
+        **{f"fused_rounds[{k}@bf16]":
+           (lambda k=k: _lower_fused_rounds(k, "bf16"))
+           for k in (1, 2, 4)},
+    },
     "parallel_fedavg": {
         "fedavg[weighted_psum]": _lower_weighted_psum,
         "fedavg[weighted_delta_bf16]": _lower_weighted_delta,
@@ -338,7 +376,13 @@ ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
 #:   Ratios carry headroom over the measured toy-program values: pure
 #:   parameter-payload programs land near 0.5, gated/robust ones higher
 #:   because the Byzantine gate's f32 scalar all_gathers (deliberately
-#:   NOT quantized) are a bigger share of the tiny toy payload.
+#:   NOT quantized) are a bigger share of the tiny toy payload;
+#: * ``collective_bytes_scale {vs, rounds}``: the program's IR collective
+#:   bytes must EQUAL the named single-round baseline's — the scan-over-
+#:   rounds invariant (collectives inside ``lax.scan`` lower once, so
+#:   logical traffic is exactly ``rounds`` × the baseline iff the IR
+#:   totals match; growth = scan unrolled, other deltas = per-round
+#:   payload re-widened).
 PROGRAM_REQUIREMENTS: Dict[str, Dict[str, dict]] = {
     "train_federated": {
         "fused_epoch[weighted@bf16]": {
@@ -351,6 +395,20 @@ PROGRAM_REQUIREMENTS: Dict[str, Dict[str, dict]] = {
             "max_collective_bytes_ratio": {
                 "vs": "fused_epoch[gated]", "ratio": 0.65},
         },
+    },
+    "fused_rounds": {
+        **{f"fused_rounds[{k}]": {
+            "collective_bytes_scale": {"vs": "fused_rounds[1]",
+                                       "rounds": k},
+           } for k in (2, 4)},
+        "fused_rounds[1@bf16]": {
+            "dtypes_present": ["bf16", "f32"],
+        },
+        **{f"fused_rounds[{k}@bf16]": {
+            "dtypes_present": ["bf16", "f32"],
+            "collective_bytes_scale": {"vs": "fused_rounds[1@bf16]",
+                                       "rounds": k},
+           } for k in (2, 4)},
     },
     "parallel_fedavg": {
         "fedavg[weighted_delta_bf16]": {

@@ -122,6 +122,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "<out-dir>/monitor_similarity.csv")
     p.add_argument("--sample-every", type=int, default=1,
                    help="epochs between synthetic snapshots; 0 = only at end")
+    p.add_argument("--rounds-per-program", type=int, default=1,
+                   help="fuse K federated rounds (local epochs + in-graph "
+                        "aggregation) into ONE lax.scan-over-rounds device "
+                        "program with a single host round trip per K rounds; "
+                        "bit-identical to K separate dispatches (the PRNG "
+                        "chain advances on device).  Hooks (--sample-every/"
+                        "--save-every/--monitor-every) still force a program "
+                        "boundary on their rounds, so a cadence below K caps "
+                        "the effective fusion.  1 = automatic (default: "
+                        "hook-free stretches still fuse, up to 16 rounds)")
     p.add_argument("--out-dir", type=str, default=".")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--save-every", type=int, default=0,
@@ -576,6 +586,9 @@ def main(argv=None) -> int:
                      "ctgan.py:28-30)")
     if not 0.0 <= args.ema_decay < 1.0:
         parser.error(f"--ema-decay {args.ema_decay}: must be in [0, 1)")
+    if args.rounds_per_program < 1:
+        parser.error(f"--rounds-per-program {args.rounds_per_program}: "
+                     "must be >= 1")
     if args.ema_decay > 0 and args.mode != "fedavg":
         parser.error("--ema-decay is only supported in fedavg mode "
                      "(single-program or multi-process), not "
@@ -970,6 +983,22 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     remaining = max(0, args.epochs - trainer.completed_epochs)
     use_hook = bool(args.sample_every or args.save_every or monitor is not None)
     fit_kwargs = {}
+    rpp = getattr(args, "rounds_per_program", 1)
+    if rpp > 1:
+        if not hasattr(trainer, "_epoch_fn_for"):
+            print("note: --rounds-per-program is not supported for this "
+                  "trainer; ignoring")
+        else:
+            # exact-K scheduling falls out of fit()'s chunk sizing: a
+            # hook-free stretch of >= K rounds runs as one fused_rounds[K]
+            # program; hooks still force boundaries on their rounds
+            fit_kwargs["max_rounds_per_call"] = rpp
+            cadences = [c for c in (args.sample_every, args.save_every,
+                                    args.monitor_every) if c]
+            if cadences and min(cadences) < rpp:
+                print(f"note: hook cadence (every {min(cadences)} rounds) "
+                      f"is below --rounds-per-program {rpp}; hooks force "
+                      "program boundaries, capping the effective fusion")
     if use_hook and hasattr(trainer, "_epoch_fn_for"):
         # tell the trainer exactly which rounds the hook acts on, so the
         # hook-free stretches fuse into single device programs

@@ -293,6 +293,93 @@ def check_precision(timeout: int = 300) -> bool:
                  f"({bf16p['cbytes'] / max(1, f32p['cbytes']):.2f}x)")
 
 
+def check_scan_rounds(timeout: int = 300) -> bool:
+    """Scan-over-rounds fusion holds its two load-bearing properties.
+
+    A subprocess (lowering must own backend init, like the contract gate)
+    lowers ``fused_rounds[4]`` next to ``fused_rounds[1]`` and asserts the
+    contract require block's invariant directly: IR collective bytes are
+    EQUAL (collectives inside the round scan lower once, so logical
+    traffic scales exactly K× — growth means the scan unrolled, any other
+    delta means the payload re-widened).  It then runs the rounds=2
+    program against two sequential rounds=1 dispatches on the harness's
+    synthetic stacks and asserts the resulting params are bit-identical —
+    the ``--rounds-per-program`` K=1 parity the trainer's fused chunks
+    depend on."""
+    import json
+    import subprocess
+
+    code = (
+        "import json\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "from fed_tgan_tpu.analysis.contracts.harness import (\n"
+        "    ENTRYPOINT_FAMILIES, N_DEVICES, require_mesh,\n"
+        "    _client_stacks, _stacked_models, _toy_cfg, _toy_spec)\n"
+        "from fed_tgan_tpu.analysis.contracts.ir import (\n"
+        "    fingerprint_text, total_collective_bytes)\n"
+        "require_mesh()\n"
+        "fams = ENTRYPOINT_FAMILIES['fused_rounds']\n"
+        "out = {}\n"
+        "for name in ('fused_rounds[1]', 'fused_rounds[4]'):\n"
+        "    fp = fingerprint_text(fams[name]().as_text())\n"
+        "    out[name] = total_collective_bytes(fp)\n"
+        "from fed_tgan_tpu.parallel.mesh import client_mesh\n"
+        "from fed_tgan_tpu.train.federated import make_federated_epoch\n"
+        "spec, cfg = _toy_spec(), _toy_cfg()\n"
+        "mesh = client_mesh(N_DEVICES)\n"
+        "data, cond, rows, steps, weights = _client_stacks(spec, cfg)\n"
+        "_one, models = _stacked_models(spec, cfg)\n"
+        "mk = lambda r: make_federated_epoch(\n"
+        "    spec, cfg, max_steps=int(steps.max()), mesh=mesh, k=1,\n"
+        "    rounds=r)\n"
+        "key = jax.random.key(0)\n"
+        "m_f, _m, _k, _fin = mk(2)(models, data, cond, rows, steps,\n"
+        "                          weights, key)\n"
+        "f1 = mk(1)\n"
+        "m_s, _m, k1, _fin = f1(models, data, cond, rows, steps,\n"
+        "                       weights, key)\n"
+        "m_s, _m, _k2, _fin = f1(m_s, data, cond, rows, steps,\n"
+        "                        weights, k1)\n"
+        "out['parity'] = bool(all(\n"
+        "    np.array_equal(np.asarray(a), np.asarray(b))\n"
+        "    for a, b in zip(jax.tree.leaves(m_f), jax.tree.leaves(m_s))))\n"
+        "print(json.dumps(out))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "scan-rounds", f"timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return _line(False, "scan-rounds",
+                     " | ".join(tail) or "lowering failed")
+    try:
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        b1, b4 = res["fused_rounds[1]"], res["fused_rounds[4]"]
+    except Exception as exc:
+        return _line(False, "scan-rounds", f"unparseable result: {exc!r}")
+    if b4 != b1:
+        hint = ("round scan unrolled?" if b4 >= 4 * b1
+                else "per-round payload re-widened?")
+        return _line(False, "scan-rounds",
+                     f"fused_rounds[4] collectives move {b4}B vs "
+                     f"fused_rounds[1] {b1}B — must be EQUAL ({hint})")
+    if not res.get("parity"):
+        return _line(False, "scan-rounds",
+                     "rounds=2 program is NOT bit-identical to two "
+                     "sequential rounds=1 dispatches")
+    return _line(True, "scan-rounds",
+                 f"fused_rounds[4] == fused_rounds[1] collective bytes "
+                 f"({b1}B -> logical 4x scaling); rounds=2 bit-identical "
+                 "to 2 sequential dispatches")
+
+
 def check_robust_aggregation() -> bool:
     """Each robust aggregator rejects a poisoned client on a tiny pytree.
 
@@ -589,6 +676,7 @@ def main(argv=None) -> int:
         check_static_analysis(),
         check_program_contracts(),
         check_precision(),
+        check_scan_rounds(),
         check_observability(),
         check_serving(),
     ]

@@ -47,12 +47,23 @@ def summarize(path: str) -> dict:
     if rounds:
         per = [e["per_round_s"] for e in rounds
                if isinstance(e.get("per_round_s"), (int, float))]
+        # two event shapes coexist: legacy = one event per device program
+        # (chunk) carrying `rounds`=size; current = one event per LOGICAL
+        # round carrying `round` + `rounds_per_program`, where the chunk
+        # head has round == first.  total_rounds and chunks therefore
+        # come out invariant to --rounds-per-program for both shapes.
+        heads = [e for e in rounds
+                 if "round" not in e or e.get("round") == e.get("first")]
         out["rounds"] = {
-            "chunks": len(rounds),
+            "chunks": len(heads),
             "total_rounds": sum(int(e.get("rounds", 1)) for e in rounds),
             "per_round_s_mean": round(sum(per) / len(per), 4) if per else None,
             "per_round_s_max": round(max(per), 4) if per else None,
         }
+        rpp = [int(e["rounds_per_program"]) for e in rounds
+               if isinstance(e.get("rounds_per_program"), int)]
+        if rpp:
+            out["rounds"]["rounds_per_program_max"] = max(rpp)
 
     alarms = [e for e in events if e.get("type") == "watchdog_alarm"]
     rollbacks = [e for e in events if e.get("type") == "watchdog_rollback"]
@@ -118,9 +129,11 @@ def render_text(summary: dict) -> str:
         lines.append(f"    {n:6d}  {t}")
     r = summary.get("rounds")
     if r:
+        rpp = r.get("rounds_per_program_max")
         lines.append(f"  rounds: {r['total_rounds']} in {r['chunks']} "
                      f"chunk(s), per-round mean {r['per_round_s_mean']}s "
-                     f"max {r['per_round_s_max']}s")
+                     f"max {r['per_round_s_max']}s"
+                     + (f", up to {rpp} round(s)/program" if rpp else ""))
     w = summary.get("watchdog")
     if w:
         lines.append(f"  watchdog: {w['alarms']} alarm(s), "

@@ -158,6 +158,15 @@ def make_federated_epoch(
 ):
     """Build the jitted SPMD program for ``rounds`` federated rounds.
 
+    This is the ``fused_rounds[K]`` program of the hlolint contracts: for
+    ``rounds`` = K > 1 the whole round body — local epochs AND the
+    in-graph aggregator — sits inside one ``lax.scan`` over rounds, so K
+    rounds cost one dispatch and one host round trip.  The CLI exposes K
+    as ``--rounds-per-program``; collectives inside the scan appear once
+    in the lowered IR regardless of K (logical collective traffic scales
+    exactly K× the single-round program — the contract ``require`` block
+    asserts this).
+
     ``update_fault`` is ``(kind, client_idx0, factor)`` from
     :func:`fed_tgan_tpu.testing.faults.update_fault_window` (or None): the
     named client's post-training parameters are corrupted every round of
@@ -439,12 +448,15 @@ class RoundBookkeeping:
         plus ``timing_phases.csv`` with the per-phase breakdown the reference
         collects but never writes (distributed.py:790-824).
 
-        When rounds are fused into one device program, per-round entries
-        inside a chunk are the chunk average (the device doesn't report
-        per-round boundaries); cumulative sums are exact at chunk boundaries,
-        which is where snapshots land, so the similarity CLI's cumulative
-        time charging stays exact.  Unfused runs record real per-round times
-        like the reference."""
+        When rounds are fused into one device program
+        (``--rounds-per-program`` / ``max_rounds_per_call``), per-round
+        entries inside a chunk are the chunk average (the device doesn't
+        report per-round boundaries) and the LAST round of each chunk
+        absorbs the division residual, so cumulative sums are exact at
+        every round boundary — not only at chunk ends, where snapshots
+        land.  The similarity CLI's cumulative time charging is therefore
+        exact for any fusion width K.  Unfused runs record real per-round
+        times like the reference."""
         import csv
         import os
 
@@ -640,7 +652,15 @@ class FederatedTrainer(RoundBookkeeping):
         fires on — pass the sparse snapshot/checkpoint schedule so the
         stretches in between collapse to single host round trips, up to
         ``max_rounds_per_call`` rounds each (bounds compile time and how much
-        wall-clock one call can hold).
+        wall-clock one call can hold).  The CLI's ``--rounds-per-program K``
+        maps onto ``max_rounds_per_call=K``: a hook-free stretch of K rounds
+        runs as ONE ``fused_rounds[K]`` device program (local epochs,
+        in-graph aggregation, and the monitor statistics all inside a
+        ``lax.scan`` over rounds) with exactly one gated ``device_get`` per
+        K rounds.  Per-round bookkeeping (epoch_times, journal events) is
+        reconstructed host-side from the chunk: each round is charged the
+        chunk-average wall time, with the last round absorbing the float
+        residual so cumulative sums stay exact at every round boundary.
 
         ``health_cb(first_round, metrics)`` (the training watchdog's hook)
         runs after each chunk with the host metric arrays, BEFORE the
@@ -708,7 +728,8 @@ class FederatedTrainer(RoundBookkeeping):
             # the span is host-side timing only (no device sync), so it
             # wraps the hot region without perturbing the transfer guard
             if use_ema:
-                with _span("train.local_steps", rounds=size), \
+                with _span("train.local_steps", rounds=size,
+                           rounds_per_program=size), \
                         hot_region(region):
                     (models, metrics, self._key, finite,
                      self.ema) = self._epoch_fn_for(size, update_fault)(
@@ -717,7 +738,8 @@ class FederatedTrainer(RoundBookkeeping):
                     )
                 self._ema_updates += size
             else:
-                with _span("train.local_steps", rounds=size), \
+                with _span("train.local_steps", rounds=size,
+                           rounds_per_program=size), \
                         hot_region(region):
                     (models, metrics, self._key,
                      finite) = self._epoch_fn_for(size, update_fault)(
@@ -817,22 +839,37 @@ class FederatedTrainer(RoundBookkeeping):
             if health_cb is not None:
                 health_cb(e, {name: np.asarray(v)
                               for name, v in metrics_host.items()})
-            per_round = (time.time() - t0 - t_pre) / size
+            t_chunk = time.time() - t0 - t_pre
+            per_round = t_chunk / size
+            # the last round absorbs the division residual so cumulative
+            # wall-clock is EXACT at every round boundary (not just chunk
+            # ends): the reconstructed per-round entries sum to the
+            # chunk's measured wall no matter how K divides it
+            last_charge = t_chunk - per_round * (size - 1)
             for ei in range(e, e + size):
                 self._finish_round(
-                    per_round, ei,
+                    last_charge if ei == last else per_round, ei,
                     sample_hook if (ei == last and ei in firing) else None,
                     pre_hook_s=t_pre if ei == last else 0.0,
                 )
             # journal/counters see only host-side values already in hand
-            # (per_round, ok, membership) -- no extra device pull
+            # (per_round, ok, membership) -- no extra device pull.  One
+            # round + one aggregate event per LOGICAL round (unpacked from
+            # the fused chunk) so `obs report` is invariant to how many
+            # rounds share a program; `round == first` marks the chunk
+            # head, and rounds_per_program records the fusion width.
             _ROUNDS_TOTAL.inc(size)
             _CHUNKS_TOTAL.inc()
-            _emit_event("round", first=e, last=last, rounds=size,
-                        per_round_s=round(per_round, 6), finite=bool(ok))
-            _emit_event("aggregate", first=e, last=last,
-                        aggregator=self.cfg.aggregator,
-                        clients=self.n_clients - len(self.dropped_clients))
+            n_live = self.n_clients - len(self.dropped_clients)
+            for ei in range(e, e + size):
+                _emit_event("round", round=ei, first=e,
+                            rounds_per_program=size,
+                            per_round_s=round(per_round, 6),
+                            finite=bool(ok))
+                _emit_event("aggregate", round=ei, first=e,
+                            rounds_per_program=size,
+                            aggregator=self.cfg.aggregator,
+                            clients=n_live)
             if log_due:
                 m = jax.tree.map(lambda x: np.asarray(x).mean(),
                                  metrics_host)

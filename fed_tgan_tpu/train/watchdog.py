@@ -148,19 +148,49 @@ def fit_with_watchdog(
     ``on_rollback(trainer)``, if given, runs after each reload (tests use
     it to clear the injected fault; production drivers can re-register
     hooks that captured the old instance).
+
+    **Round-fusion granularity** (``--rounds-per-program`` /
+    ``fit_kwargs["max_rounds_per_call"]`` = K): the trainer runs K rounds
+    as one device program, so ``health_cb`` sees each chunk's metrics
+    AFTER all K rounds completed — alarms and rollback are evaluated at
+    K-round granularity, and an alarm discards up to K rounds of work
+    (the metrics still carry a per-round axis, so the alarm message names
+    the exact offending round).  While a rollback window is active —
+    from the restore until training has re-traversed the stretch that
+    alarmed — fusion is auto-clamped to ``max_rounds_per_call=1`` so the
+    watchdog re-checks health (and any due checkpoint hook fires) after
+    every single round; once past the window, the caller's K resumes.
     """
     from fed_tgan_tpu.runtime.checkpoint import list_resumable, load_federated
 
     fit_kwargs = dict(fit_kwargs or {})
     fit_kwargs["health_cb"] = watchdog.health_cb
     target = trainer.completed_epochs + epochs
+    base_rounds = int(fit_kwargs.get("max_rounds_per_call", 16))
     gen_skip = 0            # how many newest generations to skip over
     restore_round = None    # completed_epochs right after the last restore
+    clamp_until = None      # rollback window: un-fuse rounds below this
 
     while trainer.completed_epochs < target:
+        kw, stop = fit_kwargs, target
+        if clamp_until is not None:
+            if trainer.completed_epochs < clamp_until:
+                # rollback window active: re-run one round per program so
+                # the alarm localizes to a single round and checkpoints
+                # land per round; fit() stops AT the window edge so the
+                # next iteration resumes the fused K
+                kw = {**fit_kwargs, "max_rounds_per_call": 1}
+                stop = min(clamp_until, target)
+            else:
+                clamp_until = None
         try:
-            trainer.fit(target - trainer.completed_epochs, **fit_kwargs)
+            trainer.fit(stop - trainer.completed_epochs, **kw)
         except WatchdogAlarm as alarm:
+            # the failed fit committed completed_epochs up to the chunk
+            # that alarmed; clamp fusion through the end of the stretch
+            # the (up to K-round) chunk would have covered
+            clamp_until = max(clamp_until or 0,
+                              trainer.completed_epochs + base_rounds)
             watchdog.rollbacks += 1
             _ALARMS_TOTAL.inc()
             _emit_event("watchdog_alarm", reason=str(alarm),

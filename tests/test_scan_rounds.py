@@ -1,0 +1,158 @@
+"""Scan-over-rounds (``--rounds-per-program K``) on the 8-virtual-device
+CPU mesh: a K-fused ``fused_rounds[K]`` program must be bit-identical to
+K separate dispatches — params, key chain, AND the quarantine masks the
+update gate accumulates on device — with exactly one ``device_get`` per
+K rounds, and fault windows clipping fused chunks at their edges."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.parallel.mesh import client_mesh
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+
+pytestmark = pytest.mark.scanrounds
+
+CFG = TrainConfig(embedding_dim=8, gen_dims=(16,), dis_dims=(16,),
+                  batch_size=40, pac=4)
+
+
+@pytest.fixture(scope="module")
+def fed_init8(toy_frame, toy_spec):
+    shards = shard_dataframe(toy_frame, 8, "iid", seed=9)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    return federated_initialize(clients, seed=0)
+
+
+def _fit_collecting_masks(trainer, epochs, k):
+    """fit() with a health_cb that records the device-accumulated
+    quarantine masks per chunk; returns them stacked over rounds."""
+    masks = []
+
+    def cb(first_round, metrics):
+        q = metrics.get("quarantined")
+        masks.append(np.zeros((0,)) if q is None else np.asarray(q))
+
+    trainer.fit(epochs, max_rounds_per_call=k, health_cb=cb)
+    return np.concatenate(masks, axis=0) if masks else np.zeros((0,))
+
+
+@pytest.mark.parametrize("aggregator", ["weighted", "median"])
+@pytest.mark.parametrize("precision", ["f32", "bf16"])
+def test_k4_bit_identical_to_four_k1_dispatches(fed_init8, aggregator,
+                                                precision):
+    """Params, key chain, and quarantine masks after one fused_rounds[4]
+    program == after four sequential rounds=1 dispatches (fixed seed)."""
+    cfg = dataclasses.replace(CFG, aggregator=aggregator,
+                              precision=precision)
+    mesh = client_mesh(8)
+    fused = FederatedTrainer(fed_init8, config=cfg, mesh=mesh, seed=11)
+    seq = FederatedTrainer(fed_init8, config=cfg, mesh=mesh, seed=11)
+
+    q_fused = _fit_collecting_masks(fused, 4, k=4)
+    q_seq = _fit_collecting_masks(seq, 4, k=1)
+
+    # exactly the programs the schedule implies: one rounds=4, one rounds=1
+    assert set(fused._epoch_fns) == {(4, None)}
+    assert set(seq._epoch_fns) == {(1, None)}
+    for a, b in zip(jax.tree.leaves(fused.models),
+                    jax.tree.leaves(seq.models)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        jax.random.key_data(fused._key), jax.random.key_data(seq._key))
+    np.testing.assert_array_equal(q_fused, q_seq)
+    np.testing.assert_array_equal(fused._strikes, seq._strikes)
+    assert fused.completed_epochs == seq.completed_epochs == 4
+
+
+def test_fault_window_clips_fused_chunk(fed_init8):
+    """A scale_update window crossing a fused boundary must clip the
+    chunks at the window edges (the fault is a trace-time constant), and
+    the clipped fused run must stay bit-identical to the unfused one."""
+    from fed_tgan_tpu.testing.faults import FaultPlan, install_plan
+
+    install_plan(FaultPlan.parse(
+        "scale_update:factor=1000,rank=2,round=2,until=3"))
+    try:
+        mesh = client_mesh(8)
+        fused = FederatedTrainer(fed_init8, config=CFG, mesh=mesh, seed=5)
+        seq = FederatedTrainer(fed_init8, config=CFG, mesh=mesh, seed=5)
+        q_fused = _fit_collecting_masks(fused, 5, k=4)
+        q_seq = _fit_collecting_masks(seq, 5, k=1)
+    finally:
+        install_plan(None)
+
+    # 0-based fault window is rounds 1..2: the 5-round run splits into
+    # [0] clean, [1,2] faulty, [3,4] clean — never a mid-chunk flip
+    fault = ("scale", 1, 1000.0)
+    assert set(fused._epoch_fns) == {(1, None), (2, fault), (2, None)}
+    assert set(seq._epoch_fns) == {(1, None), (1, fault)}
+    for a, b in zip(jax.tree.leaves(fused.models),
+                    jax.tree.leaves(seq.models)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(q_fused, q_seq)
+    np.testing.assert_array_equal(fused._strikes, seq._strikes)
+
+
+@pytest.mark.sanitize
+def test_one_device_get_per_k_rounds(fed_init8):
+    """With the monitor pull forced every chunk (health_cb), a K=4 run
+    makes ONE jax.device_get per 4 rounds vs 4 for the unfused run —
+    under armed sanitizers, so no implicit pull hides in the hot path."""
+    from fed_tgan_tpu.analysis.sanitizers import sanitize
+
+    mesh = client_mesh(8)
+    counts = {}
+    real = jax.device_get
+    for label, k in (("fused", 4), ("seq", 1)):
+        tr = FederatedTrainer(fed_init8, config=CFG, mesh=mesh, seed=3)
+        calls = []
+
+        def counting(x, *a, **kw):
+            calls.append(1)
+            return real(x, *a, **kw)
+
+        jax.device_get = counting
+        try:
+            with sanitize():
+                tr.fit(4, max_rounds_per_call=k,
+                       health_cb=lambda first, metrics: None)
+        finally:
+            jax.device_get = real
+        counts[label] = len(calls)
+    assert counts == {"fused": 1, "seq": 4}
+
+
+def test_report_invariant_to_rounds_per_program(fed_init8, tmp_path):
+    """`obs report` totals must not depend on how rounds pack into
+    programs: per-logical-round events make K=4 and K=1 summaries agree
+    on total_rounds while recording the fusion width."""
+    from fed_tgan_tpu.obs.journal import RunJournal, set_journal
+    from fed_tgan_tpu.obs.report import summarize
+
+    mesh = client_mesh(8)
+    sums = {}
+    for label, k in (("fused", 4), ("seq", 1)):
+        path = str(tmp_path / f"{label}.jsonl")
+        tr = FederatedTrainer(fed_init8, config=CFG, mesh=mesh, seed=2)
+        with RunJournal(path, run_id=label) as j:
+            set_journal(j)
+            try:
+                tr.fit(4, max_rounds_per_call=k)
+            finally:
+                set_journal(None)
+        sums[label] = summarize(path)
+    for label, s in sums.items():
+        assert s["rounds"]["total_rounds"] == 4, label
+        assert s["by_type"]["round"] == 4, label
+        assert s["by_type"]["aggregate"] == 4, label
+    assert sums["fused"]["rounds"]["chunks"] == 1
+    assert sums["seq"]["rounds"]["chunks"] == 4
+    assert sums["fused"]["rounds"]["rounds_per_program_max"] == 4
+    assert sums["seq"]["rounds"]["rounds_per_program_max"] == 1
