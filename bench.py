@@ -1254,12 +1254,211 @@ def bench_serving(duration_s: float = 15.0, clients: int = 4,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_serving_fleet(tenants: int = 4, clients_per_tenant: int = 2,
+                        rows_per_request: int = 50,
+                        target_requests: int = 100_000,
+                        max_duration_s: float = 300.0,
+                        seed: int = 0) -> dict:
+    """Sustained multi-tenant fleet load: a ``target_requests``-request
+    window across ``tenants`` hot models behind one in-process
+    ``serve.fleet.FleetService``.
+
+    All tenants are built identically, so the fleet's cross-tenant
+    program sharing and lane coalescing are fully exercised: the whole
+    window runs on a handful of shared compiled programs (cache stats
+    recorded).  One tenant gets a deliberately low admission quota (429
+    shed proof — the others must be unaffected: fair shedding), and one
+    tenant's artifact is REPUBLISHED mid-window, so the numbers include
+    a hot reload under fire.  Clients use persistent HTTP/1.1
+    connections; per-tenant throughput and p50/p99 latency come from
+    client-observed wall times."""
+    import http.client
+    import shutil
+    import tempfile
+    import threading
+
+    from fed_tgan_tpu.serve.demo import build_demo_artifact
+    from fed_tgan_tpu.serve.fleet import (
+        FleetRegistry,
+        FleetService,
+        ProgramCache,
+        TokenBucket,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_bench_fleet_")
+    svc = None
+    try:
+        names = [f"t{i}" for i in range(tenants)]
+        for name in names:
+            build_demo_artifact(os.path.join(tmp, name), rows=400, epochs=1,
+                                seed=seed)
+        cache = ProgramCache(max_entries=32)
+        fleet = FleetRegistry(program_cache=cache, log=lambda *a: None)
+        for name in names:
+            fleet.load(name, os.path.join(tmp, name))
+        svc = FleetService(
+            fleet, port=0, max_batch=32, queue_size=256,
+            max_lanes=8, reload_interval_s=1.0, log=lambda *a: None,
+        ).start()
+        host, port = "127.0.0.1", svc.port
+
+        # quota-shed proof: t0 is capped well below its fair request rate
+        # (~25-30 req/s/tenant closed-loop on CPU); the token bucket sheds
+        # its excess with 429 while the unlimited tenants keep their full
+        # throughput (fairness)
+        quota_rps = 10.0
+        fleet.get(names[0]).bucket = TokenBucket(quota_rps, quota_rps)
+
+        lock = threading.Lock()
+        stats = {name: {"requests": 0, "rows": 0, "shed_429": 0,
+                        "shed_503": 0, "errors": 0, "latencies": []}
+                 for name in names}
+        remaining = [int(target_requests)]
+        t_end = time.time() + max_duration_s
+
+        def warm(tenant: str) -> None:
+            conn = http.client.HTTPConnection(host, port, timeout=300)
+            conn.request("GET", f"/t/{tenant}/sample"
+                                f"?rows={rows_per_request}&seed=0")
+            conn.getresponse().read()
+            conn.close()
+
+        # warm-up: compile the W=1 bucket (shared across tenants) off the
+        # clock; lane-width variants compile inside the window — that IS
+        # part of sustained-fleet behaviour, and the LRU keeps them
+        warm_threads = [threading.Thread(target=warm, args=(n,))
+                        for n in names]
+        for t in warm_threads:
+            t.start()
+        for t in warm_threads:
+            t.join()
+
+        def client(tenant: str, idx: int) -> None:
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            st = stats[tenant]
+            i = idx * 1_000_000  # disjoint offset ranges per client
+            while time.time() < t_end:
+                with lock:
+                    if remaining[0] <= 0:
+                        break
+                    remaining[0] -= 1
+                t0 = time.time()
+                try:
+                    conn.request(
+                        "GET",
+                        f"/t/{tenant}/sample?rows={rows_per_request}"
+                        f"&seed={idx}&offset={i * rows_per_request}")
+                    resp = conn.getresponse()
+                    resp.read()
+                    status = resp.status
+                except (http.client.HTTPException, OSError):
+                    conn.close()
+                    conn = http.client.HTTPConnection(host, port,
+                                                      timeout=120)
+                    continue
+                if status == 200:
+                    with lock:
+                        st["requests"] += 1
+                        st["rows"] += rows_per_request
+                        st["latencies"].append(time.time() - t0)
+                elif status == 429:
+                    with lock:
+                        st["shed_429"] += 1
+                    time.sleep(0.005)  # over quota: brief client backoff
+                elif status == 503:
+                    with lock:
+                        st["shed_503"] += 1
+                else:
+                    with lock:
+                        st["errors"] += 1
+                i += 1
+            conn.close()
+
+        def republish() -> None:
+            # hot reload under fire: a new checkpoint generation for t1
+            # lands mid-window; the worker's validity-gated poll adopts it
+            # while that tenant keeps answering
+            build_demo_artifact(os.path.join(tmp, names[1]), rows=400,
+                                epochs=1, seed=seed + 1)
+
+        threads = [threading.Thread(target=client, args=(n, c))
+                   for n in names for c in range(clients_per_tenant)]
+        t_start = time.time()
+        for t in threads:
+            t.start()
+        republisher = threading.Timer(
+            min(10.0, max_duration_s / 3), republish)
+        republisher.start()
+        for t in threads:
+            t.join()
+        republisher.cancel()
+        elapsed = time.time() - t_start
+        snap = svc.metrics.snapshot(svc.queue_depth())
+
+        def pct(lat: list, q: float) -> float:
+            lat = sorted(lat)
+            return lat[min(len(lat) - 1, int(q * len(lat)))] if lat else 0.0
+
+        per_tenant = {}
+        for name in names:
+            st = stats[name]
+            per_tenant[name] = {
+                "requests": st["requests"],
+                "rows": st["rows"],
+                "req_per_s": round(st["requests"] / max(elapsed, 1e-9), 1),
+                "p50_ms": round(pct(st["latencies"], 0.50) * 1e3, 2),
+                "p99_ms": round(pct(st["latencies"], 0.99) * 1e3, 2),
+                "shed_429": st["shed_429"],
+                "shed_503": st["shed_503"],
+                "errors": st["errors"],
+            }
+        total_requests = sum(s["requests"] for s in stats.values())
+        total_sheds = sum(s["shed_429"] + s["shed_503"]
+                          for s in stats.values())
+        total_rows = sum(s["rows"] for s in stats.values())
+        return {
+            "metric": "bench_serving_fleet",
+            "value": round(total_requests / max(elapsed, 1e-9), 1),
+            "unit": "requests/s served",
+            "vs_baseline": 0,
+            "tenants": tenants,
+            "clients_per_tenant": clients_per_tenant,
+            "rows_per_request": rows_per_request,
+            "target_requests": target_requests,
+            "window_complete": remaining[0] <= 0,
+            "requests_attempted": target_requests - remaining[0],
+            "requests_served": total_requests,
+            "requests_shed": total_sheds,
+            "rows_per_s": round(total_rows / max(elapsed, 1e-9), 1),
+            "duration_s": round(elapsed, 2),
+            "quota_rps_t0": quota_rps,
+            "per_tenant": per_tenant,
+            "batch_occupancy": snap["batch_occupancy"],
+            "lane_dispatches": snap["lane_dispatches_total"],
+            "lane_requests": snap["lane_requests_total"],
+            "hot_reloads": sum(
+                svc.metrics.tenant_snapshot(n)["reloads_total"]
+                for n in names),
+            "program_cache": fleet.cache.stats(),
+            "server_errors": sum(
+                svc.metrics.tenant_snapshot(n)["errors_total"]
+                for n in names),
+        }
+    finally:
+        if svc is not None:
+            try:
+                svc.shutdown(drain=False)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     global CSV_PATH
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload",
                     choices=["round", "full500", "utility", "multihost",
-                             "scale", "adult", "serving"],
+                             "scale", "adult", "serving", "serving-fleet"],
                     default="round")
     ap.add_argument("--rows", type=int, default=None,
                     help="scale/adult workloads: synthetic table row count "
@@ -1277,6 +1476,12 @@ def main() -> int:
                     help="participants (default: 2; the scale workload "
                          "defaults to 32 — BASELINE.md configs 2/3 use 8, "
                          "config 5 uses 32)")
+    ap.add_argument("--target-requests", type=int, default=100_000,
+                    help="serving-fleet workload: sustained-window request "
+                         "target across all tenants (default 100k)")
+    ap.add_argument("--fleet-duration", type=float, default=300.0,
+                    help="serving-fleet workload: wall-clock cap in seconds "
+                         "for the sustained window (default 300)")
     ap.add_argument("--uniform", action="store_true",
                     help="uniform FedAvg instead of similarity-weighted "
                          "(BASELINE.md config 2; full500/utility workloads)")
@@ -1387,7 +1592,8 @@ def main() -> int:
     # scale generates its own synthetic Covertype-like table and serving
     # trains its own demo artifact — neither reads the Intrusion CSV, so
     # don't require it there
-    if args.workload not in ("scale", "adult", "serving") \
+    if args.workload not in ("scale", "adult", "serving",
+                             "serving-fleet") \
             and not os.path.exists(CSV_PATH):
         ap.error(f"Intrusion CSV not found at {CSV_PATH}; point --csv or "
                  "FED_TGAN_BENCH_CSV at a copy")
@@ -1424,6 +1630,10 @@ def main() -> int:
     if args.rounds_per_program != 1 and args.workload != "round":
         ap.error("--rounds-per-program only applies to --workload round "
                  f"(got {args.workload})")
+    if args.workload != "serving-fleet" and (
+            args.target_requests != 100_000 or args.fleet_duration != 300.0):
+        ap.error("--target-requests/--fleet-duration only apply to "
+                 f"--workload serving-fleet (got {args.workload})")
     if not 0.0 <= args.ema_decay < 1.0:
         ap.error(f"--ema-decay {args.ema_decay}: must be in [0, 1)")
     if args.ema_decay > 0 and args.select != "none":
@@ -1439,8 +1649,12 @@ def main() -> int:
         ap.error(f"--precision {args.precision} only applies to the "
                  f"round/full500/utility/serving workloads "
                  f"(got {args.workload})")
+    if args.target_requests < 1:
+        ap.error(f"--target-requests {args.target_requests}: must be >= 1")
+    if args.fleet_duration <= 0:
+        ap.error(f"--fleet-duration {args.fleet_duration}: must be positive")
     clients = args.clients if args.clients is not None else {
-        "scale": 32, "adult": 8, "serving": 4
+        "scale": 32, "adult": 8, "serving": 4, "serving-fleet": 4
     }.get(args.workload, 2)
     # multihost is CPU-gloo by construction: no accelerator probe, no tag
     if args.backend == "cpu":
@@ -1461,7 +1675,7 @@ def main() -> int:
                      ".bench_jax_cache")
     )
     epochs = args.epochs if args.epochs is not None else {
-        "multihost": 10, "scale": 50, "serving": 1
+        "multihost": 10, "scale": 50, "serving": 1, "serving-fleet": 1
     }.get(args.workload, 500)
     rows = args.rows if args.rows is not None else (
         48_842 if args.workload == "adult" else 580_000)
@@ -1565,6 +1779,13 @@ def _is_backend_unavailable(exc: BaseException) -> bool:
 def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
     if args.workload == "serving":
         return bench_serving(clients=clients, precision=args.precision)
+    if args.workload == "serving-fleet":
+        # `clients` is the TENANT count here (default 4, ISSUE floor);
+        # each tenant gets 2 closed-loop client connections
+        return bench_serving_fleet(
+            tenants=clients,
+            target_requests=args.target_requests,
+            max_duration_s=args.fleet_duration)
     if args.workload == "round":
         return bench_round(bgm_backend=bgm,
                            profile_dir=args.profile_dir,

@@ -233,6 +233,10 @@ def check_requirements(family: str, program: str, require: dict,
     * ``dtypes_present``: each listed dtype must appear in the census —
       how the bf16 contracts pin both the compute cast (bf16) and the
       f32 islands (f32);
+    * ``donation_required``: at least N donated-argument aliases
+      (``tf.aliasing_output`` / ``jax.buffer_donor``) must survive
+      lowering — the serve buckets' donated output scratch is a steady-
+      state allocation contract, and a lost alias is a REGRESSION;
     * ``max_collective_bytes_ratio {vs, ratio}``: total collective bytes
       must stay <= ratio * the named sibling program's total — the
       "~2x lower aggregation payload" criterion, immune to both programs
@@ -255,6 +259,19 @@ def check_requirements(family: str, program: str, require: dict,
                 old="present", new="absent",
                 message=f"required dtype {dt} vanished from the lowered "
                         "program (precision policy no longer applied?)"))
+    donation_req = require.get("donation_required")
+    if donation_req:
+        donated = int(fp.transfers.get("donated_args", 0))
+        if donated < int(donation_req):
+            issues.append(Issue(
+                severity=REGRESSION, family=family, program=program,
+                metric="require.donation_required",
+                old=f">= {int(donation_req)} donated arg(s)", new=donated,
+                message="output-scratch donation alias vanished from the "
+                        "lowered program (donate_argnums dropped, or the "
+                        "donated buffer went unused and was DCE'd) -- "
+                        "steady-state serving re-allocates output per "
+                        "dispatch"))
     ratio_req = require.get("max_collective_bytes_ratio")
     if ratio_req:
         vs, ratio = ratio_req["vs"], float(ratio_req["ratio"])

@@ -27,7 +27,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from fed_tgan_tpu.analysis.contracts.ir import Fingerprint, fingerprint_text
-from fed_tgan_tpu.serve.naming import serve_bucket_name
+from fed_tgan_tpu.serve.naming import fleet_bucket_name, serve_bucket_name
 
 __all__ = [
     "ENTRYPOINT_FAMILIES",
@@ -309,23 +309,77 @@ def _lower_weighted_delta():
     return jax.jit(fn).lower(prev, new, weights)
 
 
-def _lower_serve(n_steps: int, conditional: bool, precision: str = "f32"):
+#: synthetic decode layout matching ``_OUTPUT_INFO``'s encoded width
+#: (tanh+3 modes, tanh+4 modes = 9 = spec.dim): two continuous columns
+_TOY_LAYOUT = (("cont", 3), ("cont", 4))
+
+
+def _toy_tables():
+    return tuple(
+        (np.linspace(-1.0, 1.0, size, dtype=np.float32),
+         np.linspace(0.5, 1.5, size, dtype=np.float32))
+        for _, size in _TOY_LAYOUT
+    )
+
+
+def _serve_args(spec, cfg, n_steps: int):
     import jax
 
     from fed_tgan_tpu.models.ctgan import init_generator
-    from fed_tgan_tpu.serve.engine import build_bucket_program
     from fed_tgan_tpu.train.sampler import CondSampler
 
-    require_mesh()
-    spec = _toy_spec()
-    cfg = _toy_cfg(precision=precision)
-    run = build_bucket_program(spec, cfg, None, n_steps, conditional)
     params_g, state_g = init_generator(
         jax.random.key(1), cfg.embedding_dim + spec.n_opt, cfg.gen_dims,
         spec.dim)
     cond = CondSampler.from_data(_toy_matrix(spec, seed=0), spec)
-    return jax.jit(run).lower(params_g, state_g, cond, jax.random.key(0),
-                              np.int32(0), np.int32(0))
+    out = np.zeros((n_steps * cfg.batch_size, len(_TOY_LAYOUT)), np.float32)
+    return (params_g, state_g, cond, jax.random.key(0), np.int32(0),
+            np.int32(0), _toy_tables(), out)
+
+
+def _lower_serve(n_steps: int, conditional: bool, precision: str = "f32"):
+    import jax
+
+    from fed_tgan_tpu.serve.engine import build_bucket_program
+
+    require_mesh()
+    spec = _toy_spec()
+    cfg = _toy_cfg(precision=precision)
+    run = build_bucket_program(spec, cfg, _TOY_LAYOUT, n_steps, conditional)
+    # donate_argnums=7 exactly as the engine jits it: the donated output
+    # scratch must lower as an output alias (donation_required below)
+    return jax.jit(run, donate_argnums=7).lower(
+        *_serve_args(spec, cfg, n_steps))
+
+
+def _lower_serve_lanes(n_steps: int, conditional: bool, lanes: int = 2,
+                       precision: str = "f32"):
+    """The fleet's cross-tenant lane program: ``lanes`` tenants' stacked
+    params/tables through one vmapped bucket dispatch, donated lane-shaped
+    scratch — lowered exactly as ``FleetService._lane_program`` builds it."""
+    import jax
+    import jax.numpy as jnp
+
+    from fed_tgan_tpu.serve.engine import build_bucket_program
+
+    require_mesh()
+    spec = _toy_spec()
+    cfg = _toy_cfg(precision=precision)
+    run = build_bucket_program(spec, cfg, _TOY_LAYOUT, n_steps, conditional)
+
+    def lane_run(params_g, state_g, cond, key, start, pos, tables, out):
+        return jax.vmap(run)(params_g, state_g, cond, key, start, pos,
+                             tables, out)
+
+    args = _serve_args(spec, cfg, n_steps)
+    stack = lambda tree: jax.tree.map(  # noqa: E731
+        lambda x: jnp.stack([x] * lanes), tree)
+    lane_args = (stack(args[0]), stack(args[1]), stack(args[2]),
+                 jnp.stack([args[3]] * lanes),
+                 np.zeros(lanes, np.int32), np.zeros(lanes, np.int32),
+                 stack(args[6]),
+                 np.zeros((lanes,) + args[7].shape, np.float32))
+    return jax.jit(lane_run, donate_argnums=7).lower(*lane_args)
 
 
 #: family -> {program name -> zero-arg builder returning a Lowered}.
@@ -356,6 +410,9 @@ ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
            for n in (1, 4) for c in (False, True)},
         **{serve_bucket_name(n, c, "bf16"):
            (lambda n=n, c=c: _lower_serve(n, c, "bf16"))
+           for n in (1, 4) for c in (False, True)},
+        **{fleet_bucket_name(n, c, lanes=2):
+           (lambda n=n, c=c: _lower_serve_lanes(n, c, lanes=2))
            for n in (1, 4) for c in (False, True)},
     },
 }
@@ -427,10 +484,19 @@ PROGRAM_REQUIREMENTS: Dict[str, Dict[str, dict]] = {
            } for a in ("weighted", "clipped", "trimmed", "median")},
     },
     "serve_engine": {
-        serve_bucket_name(n, c, "bf16"): {
+        # donation_required: every serve bucket writes into a DONATED
+        # output scratch — losing the tf.aliasing_output/jax.buffer_donor
+        # alias (e.g. the scratch going unused and getting DCE'd, or a
+        # refactor dropping donate_argnums) re-allocates output per
+        # dispatch in steady state, which is a REGRESSION, not drift
+        **{serve_bucket_name(n, c): {"donation_required": 1}
+           for n in (1, 4) for c in (False, True)},
+        **{serve_bucket_name(n, c, "bf16"): {
             "dtypes_present": ["bf16", "f32"],
-        }
-        for n in (1, 4) for c in (False, True)
+            "donation_required": 1,
+           } for n in (1, 4) for c in (False, True)},
+        **{fleet_bucket_name(n, c, lanes=2): {"donation_required": 1}
+           for n in (1, 4) for c in (False, True)},
     },
 }
 

@@ -252,6 +252,28 @@ def check_serving_budget(engine, counter=None) -> List[str]:
     return out
 
 
+def check_fleet_budget(cache, counter=None) -> List[str]:
+    """The fleet's shared LRU compiles at most one program per cached
+    (bucket, lanes, layout) key.  Evicted-then-rebuilt programs
+    legitimately recompile, so the aggregate budget is entries +
+    evictions; the stricter one-compile-per-name check only applies
+    while nothing has been evicted."""
+    from fed_tgan_tpu.serve.naming import SERVE_BUCKET_PREFIX
+
+    counter = counter or _STATE.counter
+    stats = cache.stats() if cache is not None else None
+    if counter is None or stats is None:
+        return []
+    budget = max(1, stats["entries"] + stats["evictions"])
+    out = check_compile_budgets({SERVE_BUCKET_PREFIX: budget}, counter)
+    if stats["evictions"] == 0:
+        for name, n in counter.counts(include_noise=True).items():
+            if name.startswith(SERVE_BUCKET_PREFIX) and n > 1:
+                out.append(f"fleet program '{name}' compiled {n}x "
+                           "(budget 1) -- LRU cache miss?")
+    return out
+
+
 def compile_report(counter: Optional[CompileCounter] = None) -> str:
     counter = counter or _STATE.counter
     if counter is None:

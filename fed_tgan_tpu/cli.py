@@ -572,7 +572,11 @@ def main(argv=None) -> int:
         argv = sys.argv[1:]
     # subcommand dispatch ahead of the flag parser: every reference-compat
     # flag starts with "-", so a bare leading word is unambiguous
-    if argv and argv[0] in ("serve", "sample-client"):
+    if argv and argv[0] in ("serve", "sample-client", "fleet"):
+        if argv[0] == "fleet":
+            from fed_tgan_tpu.serve.fleet import fleet_main
+
+            return fleet_main(argv[1:])
         from fed_tgan_tpu.serve.service import client_main, serve_main
 
         return (serve_main if argv[0] == "serve" else client_main)(argv[1:])

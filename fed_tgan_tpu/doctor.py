@@ -496,6 +496,96 @@ def check_serving() -> bool:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def check_serving_fleet() -> bool:
+    """The multi-tenant fleet shares compiled programs and keeps parity.
+
+    Loads TWO tenants from identically-built demo artifacts into one
+    fleet (shared program LRU), asserts the second tenant's draw is a
+    cache HIT (cross-tenant program sharing — equal layouts resolve to
+    one compiled program), then serves both over HTTP and verifies each
+    tenant's bytes are identical to a fresh single-model engine's for
+    the same (rows, seed) — the per-tenant decode-parity criterion."""
+    import json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_doctor_fleet_")
+    svc = None
+    try:
+        from fed_tgan_tpu.serve.demo import build_demo_artifact
+        from fed_tgan_tpu.serve.engine import SamplingEngine
+        from fed_tgan_tpu.serve.fleet import (
+            FleetRegistry,
+            FleetService,
+            ProgramCache,
+        )
+        from fed_tgan_tpu.serve.registry import ModelRegistry
+
+        roots = {}
+        for name in ("alpha", "beta"):
+            root = os.path.join(tmp, name)
+            build_demo_artifact(root, rows=200, epochs=1)
+            roots[name] = root
+        cache = ProgramCache(max_entries=16)
+        fleet = FleetRegistry(program_cache=cache, log=lambda *a: None)
+        for name, root in roots.items():
+            fleet.load(name, root)
+        # cross-tenant sharing: alpha's draw builds the bucket program
+        # (miss), beta's identical-layout draw must reuse it (hit)
+        a = fleet.get("alpha").engine.sample_csv_bytes(25, seed=3)
+        misses_after_a = cache.stats()["misses"]
+        b = fleet.get("beta").engine.sample_csv_bytes(25, seed=3)
+        st = cache.stats()
+        if st["misses"] != misses_after_a or st["hits"] < 1:
+            return _line(False, "serving-fleet",
+                         f"no cross-tenant program reuse: {st}")
+        if a != b:
+            return _line(False, "serving-fleet",
+                         "identically-built tenants disagree byte-wise "
+                         "through the shared program")
+        svc = FleetService(fleet, port=0, reload_interval_s=0,
+                           log=lambda *a: None).start()
+        results: dict = {}
+
+        def fetch(tenant):
+            url = f"{svc.url}/t/{tenant}/sample?rows=25&seed=3"
+            with urllib.request.urlopen(url, timeout=120) as r:
+                results[tenant] = r.read()
+
+        threads = [threading.Thread(target=fetch, args=(t,)) for t in roots]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        single = SamplingEngine(
+            ModelRegistry(roots["alpha"], log=lambda *a: None).get())
+        want = single.sample_csv_bytes(25, seed=3)
+        for tenant in roots:
+            if results.get(tenant) != want:
+                return _line(False, "serving-fleet",
+                             f"tenant {tenant!r} bytes differ from the "
+                             "single-model engine path")
+        with urllib.request.urlopen(f"{svc.url}/fleet", timeout=30) as r:
+            status = json.loads(r.read())
+        return _line(True, "serving-fleet",
+                     f"{len(status['tenants'])} tenants shared "
+                     f"{st['entries']} compiled program(s) "
+                     f"({st['hits']} hit(s), {st['misses']} miss(es)); "
+                     "per-tenant bytes identical to the single-model "
+                     "engine")
+    except Exception as exc:
+        return _line(False, "serving-fleet", f"{exc!r}")
+    finally:
+        if svc is not None:
+            try:
+                svc.shutdown(drain=False)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def wait_healthy(timeout_min: float = 0.0, quiet_min: float = 45.0,
                  probe_timeout_s: int = 120,
                  _probe=None, _load=None, _sleep=None, _log=print) -> bool:
@@ -679,6 +769,7 @@ def main(argv=None) -> int:
         check_scan_rounds(),
         check_observability(),
         check_serving(),
+        check_serving_fleet(),
     ]
     bad = checks.count(False)
     print(f"{len(checks) - bad}/{len(checks)} checks passed")

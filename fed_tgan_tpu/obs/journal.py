@@ -31,6 +31,10 @@ compile                   XLA compile event (from the sanitizer counter)
 backend_probe             subprocess backend-responsiveness probe outcome
 device_trace              runtime/profiling device trace start/stop/failure
 serve_reload              serving hot-reloaded a model artifact
+fleet_load                fleet admin loaded a tenant model
+fleet_evict               fleet admin evicted a tenant model
+tenant_shed               per-tenant admission shed requests (rate-limited
+                          summary event carrying counts, never per-request)
 ========================  ====================================================
 
 Writers go through a process-wide current journal: ``set_journal``
@@ -70,6 +74,7 @@ EVENT_TYPES = frozenset({
     "checkpoint", "checkpoint_restore",
     "transport_reconnect", "transport_drop", "heartbeat_lapse",
     "compile", "backend_probe", "device_trace", "serve_reload",
+    "fleet_load", "fleet_evict", "tenant_shed",
 })
 
 

@@ -170,36 +170,59 @@ class Histogram:
 _METRIC_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
 
+def _series_key(name: str, labels: Optional[dict]) -> str:
+    """Prometheus series identity: ``name`` or ``name{k="v",...}`` with
+    labels in sorted key order — the registry key AND the exposition
+    form, so labeled lookups and rendering cannot disagree."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
 class MetricsRegistry:
-    """Thread-safe, get-or-create registry of named metrics."""
+    """Thread-safe, get-or-create registry of named metrics.
+
+    Metrics may carry Prometheus labels (``labels={"tenant": "a"}``):
+    each label set is its own series (own counter object), sharing the
+    base name's HELP/TYPE header in the exposition.  Unlabeled metrics
+    are keyed, snapshotted, and rendered exactly as before.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
 
-    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+    def _get_or_create(self, cls, name: str, help: str,
+                       labels: Optional[dict] = None, **kwargs):
+        key = _series_key(name, labels)
         with self._lock:
-            existing = self._metrics.get(name)
+            existing = self._metrics.get(key)
             if existing is not None:
                 if not isinstance(existing, cls):
                     raise TypeError(
-                        f"metric {name!r} already registered as "
+                        f"metric {key!r} already registered as "
                         f"{existing.kind}, requested {cls.kind}")
                 return existing
             metric = cls(name, help=help, **kwargs)
-            self._metrics[name] = metric
+            metric.labels = dict(labels) if labels else None
+            metric.series = key
+            self._metrics[key] = metric
             return metric
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                labels: Optional[dict] = None) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[dict] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
 
     def histogram(self, name: str, help: str = "",
                   buckets: Iterable[float] = DEFAULT_BUCKETS,
-                  reservoir: int = 4096) -> Histogram:
-        return self._get_or_create(Histogram, name, help,
+                  reservoir: int = 4096,
+                  labels: Optional[dict] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
                                    buckets=buckets, reservoir=reservoir)
 
     def get(self, name: str) -> Optional[object]:
@@ -211,32 +234,50 @@ class MetricsRegistry:
             return sorted(self._metrics)
 
     def snapshot(self) -> Dict[str, object]:
-        """``{name: value-or-dict}`` for every registered metric."""
+        """``{series: value-or-dict}`` for every registered metric (the
+        series key is the bare name for unlabeled metrics)."""
         with self._lock:
             metrics = list(self._metrics.values())
-        return {m.name: m.snapshot() for m in metrics}
+        return {getattr(m, "series", m.name): m.snapshot() for m in metrics}
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition of every registered metric."""
+        """Prometheus text exposition of every registered metric.  Labeled
+        series of one base name share a single HELP/TYPE header."""
         with self._lock:
-            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+            metrics = sorted(self._metrics.values(),
+                             key=lambda m: (m.name, getattr(m, "series",
+                                                            m.name)))
         lines: List[str] = []
+        headered = set()
         for m in metrics:
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
+            if m.name not in headered:
+                headered.add(m.name)
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+            labels = getattr(m, "labels", None)
+            inner = ",".join(f'{k}="{v}"' for k, v in
+                             sorted((labels or {}).items()))
             if isinstance(m, Histogram):
                 snap = m.snapshot()
+                suffix = f"{{{inner}}}" if inner else ""
+
+                def bucket_label(le: str) -> str:
+                    return (f'{{{inner},le="{le}"}}' if inner
+                            else f'{{le="{le}"}}')
+
                 cum = 0.0
                 for le in m.buckets:
                     cum = snap[f"le_{le:g}"]
-                    lines.append(f'{m.name}_bucket{{le="{le:g}"}} {cum:g}')
-                lines.append(f'{m.name}_bucket{{le="+Inf"}} '
-                             f'{snap["count"]:g}')
-                lines.append(f"{m.name}_sum {snap['sum']:g}")
-                lines.append(f"{m.name}_count {snap['count']:g}")
+                    lines.append(f"{m.name}_bucket{bucket_label(f'{le:g}')} "
+                                 f"{cum:g}")
+                lines.append(f"{m.name}_bucket{bucket_label('+Inf')} "
+                             f"{snap['count']:g}")
+                lines.append(f"{m.name}_sum{suffix} {snap['sum']:g}")
+                lines.append(f"{m.name}_count{suffix} {snap['count']:g}")
             else:
-                lines.append(f"{m.name} {m.snapshot():g}")
+                series = getattr(m, "series", m.name)
+                lines.append(f"{series} {m.snapshot():g}")
         return "\n".join(lines) + "\n"
 
 

@@ -109,6 +109,26 @@ def summarize(path: str) -> dict:
             "restores": by_type.get("checkpoint_restore", 0),
         }
 
+    serve_evs = [e for e in events
+                 if e.get("type") in ("serve_reload", "fleet_load",
+                                      "fleet_evict", "tenant_shed")]
+    if serve_evs:
+        sheds = [e for e in serve_evs if e.get("type") == "tenant_shed"]
+        shed_by_tenant: Dict[str, int] = {}
+        for e in sheds:
+            t = str(e.get("tenant", "?"))
+            shed_by_tenant[t] = shed_by_tenant.get(t, 0) + int(
+                e.get("count", 1))
+        out["serving"] = {
+            "reloads": by_type.get("serve_reload", 0),
+            "fleet_loads": by_type.get("fleet_load", 0),
+            "fleet_evicts": by_type.get("fleet_evict", 0),
+            "tenants_loaded": sorted({str(e.get("tenant")) for e in serve_evs
+                                      if e.get("type") == "fleet_load"
+                                      and e.get("tenant") is not None}),
+            "shed_by_tenant": dict(sorted(shed_by_tenant.items())),
+        }
+
     probes = [e for e in events if e.get("type") == "backend_probe"]
     if probes:
         out["backend_probes"] = {
@@ -156,6 +176,13 @@ def render_text(summary: dict) -> str:
     if ck:
         lines.append(f"  checkpoints: {ck['saved']} saved, "
                      f"{ck['restores']} restore(s), last {ck['last_path']}")
+    sv = summary.get("serving")
+    if sv:
+        lines.append(f"  serving: {sv['reloads']} hot reload(s), "
+                     f"{sv['fleet_loads']} tenant load(s), "
+                     f"{sv['fleet_evicts']} evict(s)"
+                     + (f", shed by tenant {sv['shed_by_tenant']}"
+                        if sv["shed_by_tenant"] else ""))
     bp = summary.get("backend_probes")
     if bp:
         lines.append(f"  backend probes: {bp['total']} "

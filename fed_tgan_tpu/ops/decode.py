@@ -73,6 +73,77 @@ def make_device_decode(columns: Sequence) -> Callable[[jax.Array], jax.Array]:
     return decode
 
 
+def decode_layout(columns: Sequence) -> tuple:
+    """The SHAPE of a transformer's decode plan, constants excluded:
+    ``("cont", n_active_modes)`` / ``("disc", n_options)`` per column.
+
+    Two models with equal layouts trace to identical decode programs when
+    the mode means/stds and code tables ride in as runtime arguments
+    (:func:`make_layout_decode`) — the property the serving fleet's
+    cross-tenant program sharing and the engine's keep-programs-on-reload
+    check both key on."""
+    out = []
+    for col in columns:
+        if isinstance(col, ContinuousColumn):
+            out.append(("cont", int(np.count_nonzero(col.gmm.active))))
+        else:
+            assert isinstance(col, DiscreteColumn)
+            out.append(("disc", int(col.size)))
+    return tuple(out)
+
+
+def decode_tables(columns: Sequence) -> tuple:
+    """The runtime constants matching :func:`decode_layout`: per column,
+    ``(means, stds)`` float32 arrays over the active modes for continuous
+    columns, ``(codes,)`` int32 for discrete ones.  Passed as program
+    arguments, so new constants (a hot-reloaded model that kept its
+    layout) are just new arguments to an already-compiled program."""
+    tabs = []
+    for col in columns:
+        if isinstance(col, ContinuousColumn):
+            active = np.flatnonzero(col.gmm.active)
+            tabs.append((np.asarray(col.gmm.means[active], dtype=np.float32),
+                         np.asarray(col.gmm.stds[active], dtype=np.float32)))
+        else:
+            assert isinstance(col, DiscreteColumn)
+            tabs.append((np.asarray(col.codes, dtype=np.int32),))
+    return tuple(tabs)
+
+
+def make_layout_decode(layout: tuple):
+    """Build ``decode(encoded, tables) -> (n, n_columns) float32`` from a
+    static :func:`decode_layout`.
+
+    Semantics are exactly :func:`make_device_decode`'s (same clip /
+    argmax / ``u * 4 sigma_k + mu_k`` formula, so outputs are
+    bit-identical for matching tables) — only the constants moved from
+    trace-time closures into the ``tables`` argument, which is what lets
+    same-layout tenants share one compiled program."""
+    starts, st = [], 0
+    for kind, size in layout:
+        starts.append(st)
+        st += (1 + size) if kind == "cont" else size
+    total_dim = st
+
+    def decode(encoded: jax.Array, tables) -> jax.Array:
+        assert encoded.shape[-1] == total_dim, (encoded.shape, total_dim)
+        outs = []
+        for (kind, size), start, tab in zip(layout, starts, tables):
+            if kind == "cont":
+                means, stds = tab
+                u = jnp.clip(encoded[:, start], -1.0, 1.0)
+                v = encoded[:, start + 1 : start + 1 + size]
+                k = jnp.argmax(v, axis=1)
+                outs.append(u * SCALE * stds[k] + means[k])
+            else:
+                (codes,) = tab
+                v = encoded[:, start : start + size]
+                outs.append(codes[jnp.argmax(v, axis=1)].astype(jnp.float32))
+        return jnp.stack(outs, axis=1)
+
+    return decode
+
+
 def make_device_decode_packed(columns: Sequence):
     """Like ``make_device_decode`` but with a transfer-minimal output layout.
 

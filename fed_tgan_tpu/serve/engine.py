@@ -2,8 +2,8 @@
 
 One jitted program per (batch-bucket, conditional?) fuses the generator
 forward pass, the conditional-vector draw, gumbel activation, and the
-device-side inverse transform (``ops.decode.make_device_decode``) — a
-request costs one device dispatch plus one (n, n_columns) host transfer.
+device-side inverse transform — a request costs one device dispatch plus
+one (n, n_columns) host transfer.
 
 Determinism contract: rows form a virtual stream addressed by
 ``(seed, row_offset)``.  Step ``s`` of stream ``seed`` is generated with
@@ -17,17 +17,30 @@ Conditional sampling (CTGAN's generation-time knob: fix one discrete
 column to a chosen option) swaps the empirical conditional draw for a
 constant one-hot; the condition position is a traced scalar, so every
 (column, value) pair shares one compiled program per bucket.
+
+Program identity (the fleet-sharing refactor): a bucket program's trace
+depends only on the encoded LAYOUT — output_info, the decode layout
+shape, batch/embedding/generator dims, precision — never on a model's
+constants.  Decode tables (GMM mode means/stds, code tables) ride in as
+runtime arguments (``ops.decode.make_layout_decode``), so hot reloads
+that keep the layout keep every compiled program, and tenants with equal
+layouts can share ONE compiled program per bucket through the fleet's
+LRU cache.  The result lands in a DONATED output scratch
+(``lax.dynamic_update_slice`` + ``donate_argnums``), so steady-state
+sampling writes into rotated buffers instead of allocating fresh output
+per dispatch — the donation alias is a contract requirement
+(``donation_required``), not an accident.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import numpy as np
 
 from fed_tgan_tpu.analysis.sanitizers import hot_region
-from fed_tgan_tpu.serve.naming import serve_bucket_name
+from fed_tgan_tpu.serve.naming import fleet_bucket_name, layout_tag
 from fed_tgan_tpu.serve.registry import LoadedModel
 
 
@@ -42,22 +55,29 @@ def _pow2(n: int) -> int:
     return b
 
 
-def build_bucket_program(spec, cfg, decode_fn, n_steps: int,
-                         conditional: bool):
+def build_bucket_program(spec, cfg, layout, n_steps: int, conditional: bool,
+                         tag: Optional[str] = None):
     """The un-jitted ``n_steps``-step bucket program: fused generator
-    forward + conditional draw + gumbel activation (+ device decode when
-    ``decode_fn`` is given; None returns the activated encoded matrix --
-    the contracts harness lowers that form without a trained
-    transformer).  Named via :func:`serve_bucket_name` so the sanitizer
-    compile budget and the IR contracts key off the same identity.
+    forward + conditional draw + gumbel activation + device decode over
+    runtime ``tables`` (``layout`` None skips decode and returns the
+    activated encoded matrix — the raw-output form).  Named via
+    :func:`~fed_tgan_tpu.serve.naming.fleet_bucket_name` so the sanitizer
+    compile budget and the IR contracts key off the same identity
+    (``tag=None`` keeps the pre-fleet single-model names).
 
     Signature of the returned function:
-    ``run(params_g, state_g, cond, key, start, pos)``.
+    ``run(params_g, state_g, cond, key, start, pos, tables, out)`` where
+    ``tables`` matches ``ops.decode.decode_tables`` for ``layout`` and
+    ``out`` is an output-shaped float32 scratch the caller donates
+    (``donate_argnums=7``) — the program writes the result into it via
+    ``dynamic_update_slice``, which is what makes the donation alias
+    lower (an unused donated arg is DCE'd out of the program).
     """
     import jax
     import jax.numpy as jnp
 
     from fed_tgan_tpu.models.ctgan import generator_apply
+    from fed_tgan_tpu.ops.decode import make_layout_decode
     from fed_tgan_tpu.ops.segments import apply_activate
     from fed_tgan_tpu.runtime.precision import resolve_precision
 
@@ -65,8 +85,9 @@ def build_bucket_program(spec, cfg, decode_fn, n_steps: int,
     # getattr: cfg may be a pre-precision TrainConfig restored from an old
     # saved model artifact — those trained (and serve) in f32
     pol = resolve_precision(getattr(cfg, "precision", "f32"))
+    decode = make_layout_decode(layout) if layout is not None else None
 
-    def run(params_g, state_g, cond, key, start, pos):
+    def run(params_g, state_g, cond, key, start, pos, tables, out):
         # one step == make_sample_step's draw exactly (kz/kc/ka split
         # order), so the unconditional stream is bit-identical to
         # SavedSynthesizer.sample_encoded's schedule
@@ -90,26 +111,58 @@ def build_bucket_program(spec, cfg, decode_fn, n_steps: int,
         def body(carry, i):
             return carry, single(jax.random.fold_in(key, start + i))
 
-        _, out = jax.lax.scan(body, None, jnp.arange(n_steps))
+        _, enc = jax.lax.scan(body, None, jnp.arange(n_steps))
         # decode (quantile inverse transform) is an f32 island under bf16;
         # the cast is a traced no-op in f32 mode
-        flat = out.reshape(n_steps * B, -1).astype(jnp.float32)
-        return decode_fn(flat) if decode_fn is not None else flat
+        flat = enc.reshape(n_steps * B, -1).astype(jnp.float32)
+        result = decode(flat, tables) if decode is not None else flat
+        # write into the donated scratch: the full-buffer update makes the
+        # scratch a USED operand, so the donation lowers as an output
+        # alias instead of being dead-code-eliminated
+        return jax.lax.dynamic_update_slice(out, result, (0, 0))
 
     # distinct compiled-program name per bucket, so the sanitizer compile
     # counter can assert "<= one compile per bucket" and the contracts
     # can key the fingerprint
-    run.__name__ = serve_bucket_name(n_steps, conditional, pol.name)
+    run.__name__ = fleet_bucket_name(n_steps, conditional, pol.name, 1, tag)
     run.__qualname__ = run.__name__
     return run
 
 
-class SamplingEngine:
-    """Offset-addressable deterministic sampling over one loaded model."""
+class EngineSnapshot(NamedTuple):
+    """One consistent view of the engine's serving state, captured under
+    the engine lock — everything a multi-chunk sample (or a fleet batch
+    already formed for this model) needs, immune to a concurrent hot
+    reload swapping fields out from under it mid-request."""
 
-    def __init__(self, model: LoadedModel, max_chunk_steps: int = 128):
+    model: LoadedModel
+    spec: object
+    cfg: object
+    layout: tuple
+    tables: tuple
+    sig: tuple        # full trace identity (layout key)
+    tag: Optional[str]
+
+
+class SamplingEngine:
+    """Offset-addressable deterministic sampling over one loaded model.
+
+    ``program_cache`` (optional) is a fleet-shared LRU with a
+    ``get_or_build(key, builder, est_bytes)`` contract; when given,
+    bucket programs are keyed by the full layout signature and NAMED with
+    its tag, so same-layout tenants resolve to one compiled program and
+    different-layout ones cannot collide.  Without it the engine keeps
+    its private dict (the single-model PR 3 shape, same legacy names).
+    """
+
+    def __init__(self, model: LoadedModel, max_chunk_steps: int = 128,
+                 program_cache=None):
         self.max_chunk_steps = max_chunk_steps
+        self._cache = program_cache
         self._programs: dict = {}
+        # dead output buffers by shape, rotated back in as donated scratch
+        # once their host copy has completed (at most 2 live per shape)
+        self._scratch: dict = {}
         # HTTP handler threads read (resolve_condition, self.model) while
         # the batch worker swaps models / fills the program cache — the
         # lock makes adoption atomic w.r.t. readers (jaxlint J05)
@@ -117,66 +170,87 @@ class SamplingEngine:
         self._adopt_fields(model)
 
     def _adopt_fields(self, model: LoadedModel) -> None:
-        from fed_tgan_tpu.ops.decode import make_device_decode
+        import jax
+
+        from fed_tgan_tpu.ops.decode import decode_layout, decode_tables
 
         self.model = model
         synth = model.synth
         self.spec, self.cfg = synth.spec, synth.cfg
-        self._decode_fn = make_device_decode(synth.transformer.columns)
+        columns = synth.transformer.columns
+        self._layout = decode_layout(columns)
+        # one h2d put at adopt time, not one per dispatch
+        self._tables = jax.device_put(decode_tables(columns))
+        self._sig = self.layout_key(model)
+        self._tag = layout_tag(self._sig) if self._cache is not None else None
+
+    @staticmethod
+    def layout_key(model: LoadedModel) -> tuple:
+        """Everything a bucket program's TRACE depends on — and nothing a
+        model's constants feed.  Equal keys => identical lowered programs
+        (decode tables are runtime arguments), which is the fleet's
+        cross-tenant sharing criterion and the reload keep-programs one."""
+        from fed_tgan_tpu.ops.decode import decode_layout
+
+        synth = model.synth
+        cfg = synth.cfg
+        return (
+            tuple(synth.transformer.output_info),
+            decode_layout(synth.transformer.columns),
+            int(cfg.batch_size), int(cfg.embedding_dim),
+            tuple(cfg.gen_dims),
+            getattr(cfg, "precision", "f32"),
+        )
 
     def adopt(self, model: LoadedModel) -> bool:
-        """Swap in a hot-reloaded model.  When the encoded layout and
-        sampling config are unchanged (the common keep-training case) the
-        compiled programs are kept — new params are just new arguments —
-        and adoption is free; otherwise the program cache is rebuilt.
+        """Swap in a hot-reloaded model.  When the layout signature is
+        unchanged (the common keep-training case) every compiled program
+        is kept — new params and new decode tables are just new arguments
+        — and adoption is free; otherwise the private program dict is
+        dropped (a shared fleet cache is left alone: other tenants may
+        still serve from those entries, and stale ones age out via LRU).
         Returns whether the programs were kept."""
         with self._lock:
-            same_shape = (
-                model.synth.transformer.output_info
-                == self.model.synth.transformer.output_info
-                and model.synth.cfg == self.cfg
-                and self._decode_plan_signature(model)
-                == self._decode_plan_signature(self.model)
-            )
+            same_shape = self.layout_key(model) == self._sig
             if not same_shape:
                 self._programs = {}
+                self._scratch = {}
             self._adopt_fields(model)
             return same_shape
 
-    @staticmethod
-    def _decode_plan_signature(model: LoadedModel) -> tuple:
-        """The decode constants a compiled program bakes in: GMM mode
-        means/stds per continuous column, code tables per discrete one."""
-        from fed_tgan_tpu.features.transformer import ContinuousColumn
-
-        sig = []
-        for col in model.synth.transformer.columns:
-            if isinstance(col, ContinuousColumn):
-                active = np.flatnonzero(col.gmm.active)
-                sig.append(("cont", col.gmm.means[active].tobytes(),
-                            col.gmm.stds[active].tobytes()))
-            else:
-                sig.append(("disc", np.asarray(col.codes).tobytes()))
-        return tuple(sig)
-
     # ------------------------------------------------------------ programs
 
-    def _program(self, n_steps: int, conditional: bool):
-        key = (n_steps, conditional)
+    def snapshot(self) -> EngineSnapshot:
+        """Capture one consistent serving state under the lock.  A sample
+        (or a fleet batch) formed against this snapshot keeps using the
+        SAME model/tables/programs even if a hot reload adopts a new
+        model mid-flight — the reload-under-fire safety contract."""
         with self._lock:
-            return self._program_fill(key, n_steps, conditional)
+            return EngineSnapshot(self.model, self.spec, self.cfg,
+                                  self._layout, self._tables, self._sig,
+                                  self._tag)
 
-    def _program_fill(self, key, n_steps: int, conditional: bool):
-        # only ever called with self._lock held (see _program/adopt)
-        if key not in self._programs:
+    def _program(self, snap: EngineSnapshot, n_steps: int,
+                 conditional: bool):
+        key = (n_steps, conditional, snap.sig)
+
+        def build():
             import jax
 
-            run = build_bucket_program(
-                self.spec, self.cfg, self._decode_fn, n_steps, conditional
-            )
-            with self._lock:  # re-entrant: callers already hold it
-                self._programs[key] = jax.jit(run)
-        return self._programs[key]
+            run = build_bucket_program(snap.spec, snap.cfg, snap.layout,
+                                       n_steps, conditional, tag=snap.tag)
+            return jax.jit(run, donate_argnums=7)
+
+        if self._cache is not None:
+            B = snap.cfg.batch_size
+            n_cols = len(snap.layout)
+            # rough live-footprint estimate: encoded intermediate + output
+            est = n_steps * B * (snap.spec.dim + n_cols) * 4
+            return self._cache.get_or_build(key, build, est_bytes=est)
+        with self._lock:
+            if key not in self._programs:
+                self._programs[key] = build()
+            return self._programs[key]
 
     def _chunk_plan(self, first_step: int, total_steps: int):
         """(start_step, n_steps) chunks covering ``total_steps`` from
@@ -192,6 +266,28 @@ class SamplingEngine:
             plan.append((start, steps))
             start += steps
         return plan
+
+    # ------------------------------------------------------- scratch pool
+
+    def _scratch_take(self, shape: tuple):
+        """A donated-output scratch for ``shape``: a dead buffer from the
+        pool when one exists (its host copy completed), else a fresh
+        zeros.  Donation invalidates whatever we hand out, so a buffer is
+        either in the pool or owned by exactly one dispatch."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            bufs = self._scratch.get(shape)
+            if bufs:
+                return bufs.pop()
+        return jnp.zeros(shape, jnp.float32)
+
+    def _scratch_give(self, buf) -> None:
+        shape = tuple(buf.shape)
+        with self._lock:
+            bufs = self._scratch.setdefault(shape, [])
+            if len(bufs) < 2:  # double-buffered dispatch: 2 covers it
+                bufs.append(buf)
 
     # ------------------------------------------------------------ sampling
 
@@ -252,20 +348,25 @@ class SamplingEngine:
         return int(self.spec.cond_offsets[idx]) + int(slots[0])
 
     def sample_decoded(self, n: int, seed: int = 0, offset: int = 0,
-                       condition: Optional[int] = None) -> np.ndarray:
+                       condition: Optional[int] = None,
+                       snap: Optional[EngineSnapshot] = None) -> np.ndarray:
         """Rows [offset, offset + n) of stream ``seed`` as the decoded
         numeric (n, n_columns) matrix (device decode, float32).
 
         ``condition``: a position from :meth:`resolve_condition`, or None
-        for the empirical conditional draw (the reference's sampling)."""
+        for the empirical conditional draw (the reference's sampling).
+        ``snap``: an :class:`EngineSnapshot` to sample against (defaults
+        to a fresh one) — the whole multi-chunk draw reads ONE model."""
         import jax
 
         if n <= 0:
             raise ValueError(f"n={n}: need at least one row")
         if offset < 0:
             raise ValueError(f"offset={offset}: must be >= 0")
-        B = self.cfg.batch_size
-        synth = self.model.synth
+        if snap is None:
+            snap = self.snapshot()
+        B = snap.cfg.batch_size
+        synth = snap.model.synth
         first_step, skip = divmod(offset, B)
         total_steps = -(-(skip + n) // B)
         key = jax.random.key(seed + synth.key_offset)
@@ -273,42 +374,53 @@ class SamplingEngine:
         pos = np.int32(condition if conditional else 0)
 
         out, pending = [], []
+
+        def harvest(buf) -> np.ndarray:
+            host = np.asarray(buf)   # host copy done: buffer is dead now
+            self._scratch_give(buf)  # rotate it back in as donated scratch
+            return host
+
         for start, steps in self._chunk_plan(first_step, total_steps):
             # double-buffered like SampleProgramCache.sample: chunk i+1
             # computes while chunk i transfers, at most 2 buffers live
-            prog = self._program(steps, conditional)
+            prog = self._program(snap, steps, conditional)
+            scratch = self._scratch_take((steps * B, len(snap.layout)))
             with hot_region(f"serve.engine[{steps}"
                             f"{'c' if conditional else ''}]"):
                 chunk = prog(
                     synth.params_g, synth.state_g, synth.cond, key, start,
-                    pos
+                    pos, snap.tables, scratch
                 )
             chunk.copy_to_host_async()
             pending.append(chunk)
             if len(pending) == 2:
-                out.append(np.asarray(pending.pop(0)))
-        out.extend(np.asarray(p) for p in pending)
+                out.append(harvest(pending.pop(0)))
+        out.extend(harvest(p) for p in pending)
         return np.concatenate(out, axis=0)[skip:skip + n]
 
     def sample_frame(self, n: int, seed: int = 0, offset: int = 0,
-                     condition: Optional[int] = None):
+                     condition: Optional[int] = None,
+                     snap: Optional[EngineSnapshot] = None):
         """Decoded raw-format DataFrame (categories as strings, dates
         rejoined) — exactly what the one-shot CSV path writes."""
         from fed_tgan_tpu.data.decode import decode_matrix
 
+        if snap is None:
+            snap = self.snapshot()
         mat = self.sample_decoded(n, seed=seed, offset=offset,
-                                  condition=condition)
-        return decode_matrix(mat, self.model.meta, self.model.encoders)
+                                  condition=condition, snap=snap)
+        return decode_matrix(mat, snap.model.meta, snap.model.encoders)
 
     def sample_csv_bytes(self, n: int, seed: int = 0, offset: int = 0,
                          condition: Optional[int] = None,
-                         header: bool = True) -> bytes:
+                         header: bool = True,
+                         snap: Optional[EngineSnapshot] = None) -> bytes:
         """CSV bytes with the same formatting as ``data.csvio.write_csv``
         (the one-shot file), so served output is byte-comparable to it."""
         from fed_tgan_tpu.data.csvio import csv_bytes
 
         frame = self.sample_frame(n, seed=seed, offset=offset,
-                                  condition=condition)
+                                  condition=condition, snap=snap)
         out = csv_bytes(frame)
         if not header:
             out = out.split(b"\n", 1)[1]

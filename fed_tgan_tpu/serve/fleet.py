@@ -1,0 +1,1018 @@
+"""Multi-tenant serving fleet: many hot models behind one HTTP server.
+
+Three layers on top of the single-model :mod:`~fed_tgan_tpu.serve.service`
+shape:
+
+**FleetRegistry** — an ordered map of tenant name -> (per-tenant
+:class:`~.registry.ModelRegistry`, per-tenant
+:class:`~.engine.SamplingEngine`).  Each tenant keeps its own validity-
+gated hot reload (content-hash identity, torn-write tolerance) exactly as
+the single-model path does; load/evict are admin operations journaled as
+``fleet_load`` / ``fleet_evict``.
+
+**ProgramCache** — a byte- and entry-budgeted LRU of compiled bucket
+programs shared by every tenant engine.  Programs are keyed by the full
+layout signature (:meth:`SamplingEngine.layout_key`), which is the trace
+identity: tenants whose encoded layouts are equal resolve to the SAME
+compiled program per (bucket, conditional) pair — N same-schema tenants
+cost one compile, not N.  Different-layout tenants get differently-named
+programs (the ``_L<tag>`` suffix), so the sanitizer compile budget still
+holds per name.
+
+**FleetService** — one bounded queue + one batch worker for the whole
+fleet.  The worker coalesces ACROSS tenants: queued single-chunk requests
+are grouped by bucket key ``(steps, conditional, layout-sig)`` and each
+group rides ONE vmapped device dispatch (per-tenant params/tables stacked
+on a lane axis, output sliced and decoded per tenant on the way out) —
+requests from different tenants with the same encoded layout share a
+device program launch.  Lane programs write into a donated lane-shaped
+scratch exactly like the single-model buckets (``donation_required`` is a
+contract on both).  Multi-chunk requests and singleton groups fall back
+to the tenant engine's path against a per-batch snapshot, so a hot reload
+can never swap a model out from under a batch already formed for it.
+
+Admission is per-tenant and two-staged: a token bucket (configured
+requests/second + burst) sheds with **429** ``reason=quota`` BEFORE the
+queue, and a per-tenant in-flight cap (a share of the queue) plus the
+bounded queue itself shed with **503** ``reason=capacity`` — one hot
+tenant cannot starve the rest.  Sheds are counted per tenant (labeled
+metrics) and journaled as rate-limited ``tenant_shed`` summary events.
+
+Endpoints: ``/t/<tenant>/sample`` (per-tenant sampling, same params as
+``/sample``), ``/fleet`` (GET list / POST ``{"action": "load"|"evict"}``
+admin), ``/healthz``, ``/metrics`` (per-tenant labeled Prometheus
+series), and ``/sample`` as a single-tenant convenience alias.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.parse
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from fed_tgan_tpu.analysis.sanitizers import hot_region
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.serve.engine import (
+    ConditionError,
+    EngineSnapshot,
+    SamplingEngine,
+    build_bucket_program,
+)
+from fed_tgan_tpu.serve.metrics import FleetMetrics
+from fed_tgan_tpu.serve.naming import fleet_bucket_name
+from fed_tgan_tpu.serve.registry import ArtifactError, ModelRegistry
+
+_STOP = object()
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+# --------------------------------------------------------------- admission
+
+
+class TokenBucket:
+    """Per-tenant admission rate limiter: ``rate`` tokens/second refill up
+    to ``burst``; ``allow()`` spends one.  ``rate <= 0`` disables the
+    quota (always allows).  Thread-safe — HTTP handler threads race."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else max(rate, 1.0))
+        self._tokens = self.burst
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def allow(self, amount: float = 1.0) -> bool:
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= amount:
+                self._tokens -= amount
+                return True
+            return False
+
+    def retry_after_s(self) -> float:
+        """Seconds until one token exists — the 429 Retry-After hint."""
+        if self.rate <= 0:
+            return 0.0
+        with self._lock:
+            missing = max(0.0, 1.0 - self._tokens)
+        return missing / self.rate
+
+
+# ------------------------------------------------------------ program LRU
+
+
+class ProgramCache:
+    """Entry- and byte-budgeted LRU of compiled programs.
+
+    ``get_or_build(key, builder, est_bytes)`` is the whole contract (the
+    engine duck-types against it): a hit moves the entry to the MRU end;
+    a miss calls ``builder()`` OUTSIDE the lock (jit construction must
+    not serialize the request path) and inserts, then evicts from the
+    LRU end until both budgets hold.  The just-inserted entry is never
+    evicted — a program the caller is about to dispatch must survive its
+    own insertion even when ``est_bytes`` alone exceeds the budget."""
+
+    def __init__(self, max_entries: int = 64,
+                 max_bytes: int = 256 * 1024 * 1024):
+        self.max_entries = max(1, int(max_entries))
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()  # key -> (program, bytes)
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get_or_build(self, key, builder: Callable, est_bytes: int = 0):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry[0]
+        program = builder()
+        with self._lock:
+            racer = self._entries.get(key)
+            if racer is not None:  # another thread built it meanwhile
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return racer[0]
+            self.misses += 1
+            self._entries[key] = (program, int(est_bytes))
+            self._bytes += int(est_bytes)
+            while self._entries and len(self._entries) > 1 and (
+                    len(self._entries) > self.max_entries
+                    or self._bytes > self.max_bytes):
+                _, (_, b) = self._entries.popitem(last=False)
+                self._bytes -= b
+                self.evictions += 1
+            return program
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+# ------------------------------------------------------------- fleet state
+
+
+@dataclass
+class TenantRuntime:
+    """One hot tenant: its registry, its engine (sharing the fleet program
+    cache), and its admission token bucket."""
+
+    name: str
+    root: str
+    registry: ModelRegistry
+    engine: SamplingEngine
+    bucket: TokenBucket
+
+
+class FleetRegistry:
+    """Ordered map of hot tenants over one shared :class:`ProgramCache`.
+
+    ``load`` constructs the tenant's ModelRegistry + SamplingEngine (the
+    model loads eagerly — a tenant is either hot or absent, never
+    half-loaded) and journals ``fleet_load``; ``evict`` drops the tenant
+    and journals ``fleet_evict``.  Compiled programs are NOT dropped on
+    evict: other tenants may share them, and orphaned ones age out of
+    the LRU."""
+
+    def __init__(self, program_cache: Optional[ProgramCache] = None,
+                 quota_rps: float = 0.0, quota_burst: Optional[float] = None,
+                 max_chunk_steps: int = 128,
+                 allow_meta_mismatch: bool = False, log=print):
+        self.cache = program_cache if program_cache is not None \
+            else ProgramCache()
+        self.quota_rps = float(quota_rps)
+        self.quota_burst = quota_burst
+        self.max_chunk_steps = int(max_chunk_steps)
+        self.allow_meta_mismatch = allow_meta_mismatch
+        self._log = log
+        self._lock = threading.RLock()
+        self._tenants: OrderedDict = OrderedDict()  # name -> TenantRuntime
+
+    def load(self, name: str, root: str) -> TenantRuntime:
+        """Load (or replace) tenant ``name`` from artifact ``root``.
+        Raises :class:`ArtifactError` when nothing loadable exists —
+        the fleet's state is unchanged in that case."""
+        registry = ModelRegistry(root,
+                                 allow_meta_mismatch=self.allow_meta_mismatch,
+                                 log=self._log)
+        model = registry.get()  # eager: fail here, not on first request
+        engine = SamplingEngine(model, max_chunk_steps=self.max_chunk_steps,
+                                program_cache=self.cache)
+        rt = TenantRuntime(
+            name=name, root=str(root), registry=registry, engine=engine,
+            bucket=TokenBucket(self.quota_rps, self.quota_burst),
+        )
+        with self._lock:
+            self._tenants[name] = rt
+        _emit_event("fleet_load", tenant=name, model_id=model.model_id,
+                    root=str(root))
+        self._log(f"fleet: loaded tenant {name!r} "
+                  f"(model {model.model_id})")
+        return rt
+
+    def evict(self, name: str) -> bool:
+        with self._lock:
+            rt = self._tenants.pop(name, None)
+        if rt is None:
+            return False
+        _emit_event("fleet_evict", tenant=name,
+                    model_id=rt.registry.get().model_id)
+        self._log(f"fleet: evicted tenant {name!r}")
+        return True
+
+    def get(self, name: str) -> Optional[TenantRuntime]:
+        with self._lock:
+            return self._tenants.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._tenants)
+
+    def items(self) -> List[Tuple[str, TenantRuntime]]:
+        with self._lock:
+            return list(self._tenants.items())
+
+    def sole(self) -> Optional[TenantRuntime]:
+        """The single hot tenant, when exactly one is — the ``/sample``
+        alias only routes unambiguously."""
+        with self._lock:
+            if len(self._tenants) == 1:
+                return next(iter(self._tenants.values()))
+            return None
+
+
+# ----------------------------------------------------------- request path
+
+
+@dataclass
+class _FleetRequest:
+    tenant: str
+    n: int
+    seed: int
+    offset: int
+    condition: int | None
+    header: bool
+    enqueued_at: float = field(default_factory=time.time)
+    done: threading.Event = field(default_factory=threading.Event)
+    result: bytes | None = None
+    error: str | None = None
+    status: int = 500
+
+
+@dataclass
+class _Member:
+    """One request bound to the tenant snapshot its batch formed under."""
+
+    req: _FleetRequest
+    rt: TenantRuntime
+    snap: EngineSnapshot
+    first_step: int
+    skip: int
+
+
+def _stack_pytrees(trees: list):
+    """Stack a list of structurally-identical pytrees leaf-wise along a
+    new leading lane axis.  Unflattens with the FIRST tree's treedef, so
+    aux-data equality across tenants (e.g. spec objects that compare by
+    identity) is never consulted — group membership already guarantees
+    trace-equal structure."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves0, treedef = jax.tree.flatten(trees[0])
+    cols = [jax.tree.flatten(t)[0] for t in trees]
+    stacked = [jnp.stack([col[i] for col in cols])
+               for i in range(len(leaves0))]
+    return jax.tree.unflatten(treedef, stacked)
+
+
+class FleetService:
+    """One bounded queue + one coalescing batch worker over a fleet."""
+
+    def __init__(self, fleet: FleetRegistry, host: str = "127.0.0.1",
+                 port: int = 0, max_batch: int = 16, queue_size: int = 128,
+                 max_lanes: int = 8, queue_share: float = 0.5,
+                 request_timeout_s: float = 120.0,
+                 reload_interval_s: float = 5.0, log=print):
+        self.fleet = fleet
+        self.metrics = FleetMetrics()
+        self.max_batch = max(1, int(max_batch))
+        self.max_lanes = max(1, int(max_lanes))
+        self.queue_share = min(1.0, max(0.0, float(queue_share)))
+        self.request_timeout_s = request_timeout_s
+        self.reload_interval_s = reload_interval_s
+        self._log = log
+        self._host, self._port = host, port
+        self._queue: queue.Queue = queue.Queue(
+            maxsize=max(1, int(queue_size)))
+        self._draining = threading.Event()
+        self._last_reload_check = time.monotonic()
+        # per-tenant in-flight counts (admission fairness) + shed
+        # accumulators for the rate-limited tenant_shed journal events
+        self._adm_lock = threading.Lock()
+        self._inflight: dict = {}
+        self._shed_acc: dict = {}
+        # dead lane-shaped output buffers rotated back in as donated
+        # scratch, same discipline as the engine's per-model pool
+        self._scratch_lock = threading.Lock()
+        self._scratch: dict = {}
+        self._httpd: ThreadingHTTPServer | None = None
+        self._worker_thread: threading.Thread | None = None
+        self._serve_thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "FleetService":
+        handler = _make_fleet_handler(self)
+        self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
+        self._httpd.daemon_threads = True
+        self._worker_thread = threading.Thread(
+            target=self._worker, name="fleet-batch-worker", daemon=True)
+        self._worker_thread.start()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            name="fleet-http", daemon=True)
+        self._serve_thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "start() first"
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def shutdown(self, drain: bool = True) -> None:
+        self._draining.set()
+        if not drain:
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is not _STOP:
+                    req.error, req.status = "server shutting down", 503
+                    self._finish(req)
+        try:
+            self._queue.put_nowait(_STOP)
+        except queue.Full:
+            pass  # worker is alive and draining; it exits on _draining
+        if self._worker_thread is not None:
+            self._worker_thread.join(timeout=max(self.request_timeout_s, 10))
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10)
+
+    # ----------------------------------------------------------- admission
+
+    def tenant_cap(self) -> int:
+        """Max in-flight requests one tenant may hold — its fair share of
+        the bounded queue."""
+        return max(1, int(self._queue.maxsize * self.queue_share))
+
+    def submit(self, rt: TenantRuntime,
+               req: _FleetRequest) -> Optional[str]:
+        """Admit + enqueue; returns None on success or the shed reason
+        (``"quota"`` -> 429, ``"capacity"`` -> 503)."""
+        if self._draining.is_set():
+            return "capacity"
+        if not rt.bucket.allow():
+            self._shed(req.tenant, "quota")
+            return "quota"
+        cap = self.tenant_cap()
+        with self._adm_lock:
+            over_cap = self._inflight.get(req.tenant, 0) >= cap
+            if not over_cap:
+                self._inflight[req.tenant] = \
+                    self._inflight.get(req.tenant, 0) + 1
+        if over_cap:  # shed OUTSIDE _adm_lock: _shed re-acquires it
+            self._shed(req.tenant, "capacity")
+            return "capacity"
+        try:
+            self._queue.put_nowait(req)
+            return None
+        except queue.Full:
+            with self._adm_lock:
+                self._inflight[req.tenant] -= 1
+            self._shed(req.tenant, "capacity")
+            return "capacity"
+
+    def _shed(self, tenant: str, reason: str) -> None:
+        self.metrics.record_shed(tenant, reason)
+        # journal at most ~1 event/second/tenant, carrying counts — a
+        # shed storm must not turn the journal into a per-request log
+        with self._adm_lock:
+            acc = self._shed_acc.setdefault(
+                tenant, {"quota": 0, "capacity": 0, "last": 0.0})
+            acc[reason] += 1
+            now = time.monotonic()
+            if now - acc["last"] < 1.0:
+                return
+            quota, capacity = acc["quota"], acc["capacity"]
+            acc["quota"] = acc["capacity"] = 0
+            acc["last"] = now
+        _emit_event("tenant_shed", tenant=tenant, count=quota + capacity,
+                    quota=quota, capacity=capacity)
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def _finish(self, req: _FleetRequest) -> None:
+        with self._adm_lock:
+            n = self._inflight.get(req.tenant, 0)
+            if n > 0:
+                self._inflight[req.tenant] = n - 1
+        req.done.set()
+
+    def _fail(self, req: _FleetRequest, status: int, msg: str) -> None:
+        req.error, req.status = msg, status
+        self.metrics.record_error(req.tenant)
+        self._finish(req)
+
+    # -------------------------------------------------------------- worker
+
+    def _worker(self) -> None:
+        while True:
+            try:
+                item = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._draining.is_set():
+                    return
+                self._maybe_reload()
+                continue
+            if item is _STOP:
+                self._process(self._drain_remaining())
+                return
+            batch = [item]
+            stop = False
+            while len(batch) < self.max_batch:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                batch.append(nxt)
+            self._process(batch)
+            if stop:
+                self._process(self._drain_remaining())
+                return
+            self._maybe_reload()
+
+    def _drain_remaining(self) -> list:
+        batch = []
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return batch
+            if req is not _STOP:
+                batch.append(req)
+
+    def _process(self, batch: list) -> None:
+        if not batch:
+            return
+        self.metrics.record_batch(len(batch))
+        # bind every request to ONE tenant snapshot for the whole batch
+        # (reload-under-fire safety), then group single-chunk requests by
+        # bucket key: same (steps, conditional, layout-sig) => same
+        # compiled program => one vmapped dispatch for the lot
+        groups: dict = {}
+        singles: list = []
+        for req in batch:
+            rt = self.fleet.get(req.tenant)
+            if rt is None:
+                self._fail(req, 410, f"tenant {req.tenant!r} was evicted")
+                continue
+            snap = rt.engine.snapshot()
+            B = snap.cfg.batch_size
+            first_step, skip = divmod(req.offset, B)
+            total_steps = -(-(skip + req.n) // B)
+            plan = rt.engine._chunk_plan(first_step, total_steps)
+            member = _Member(req, rt, snap, first_step, skip)
+            if len(plan) == 1 and self.max_lanes > 1:
+                key = (plan[0][1], req.condition is not None, snap.sig)
+                groups.setdefault(key, []).append(member)
+            else:
+                singles.append(member)
+        for (steps, conditional, _sig), members in groups.items():
+            if len(members) == 1:
+                singles.append(members[0])
+                continue
+            for i in range(0, len(members), self.max_lanes):
+                self._dispatch_lanes(steps, conditional,
+                                     members[i:i + self.max_lanes])
+        for member in singles:
+            self._run_single(member)
+        self.metrics.set_fleet_state(len(self.fleet.names()),
+                                     self.fleet.cache.stats())
+
+    def _run_single(self, m: _Member) -> None:
+        req = m.req
+        try:
+            req.result = m.rt.engine.sample_csv_bytes(
+                req.n, seed=req.seed, offset=req.offset,
+                condition=req.condition, header=req.header, snap=m.snap,
+            )
+            req.status = 200
+            self.metrics.record_request(req.tenant,
+                                        time.time() - req.enqueued_at, req.n)
+            self._finish(req)
+        except Exception as exc:  # noqa: BLE001 — becomes the 500 body
+            self._fail(req, 500, repr(exc))
+
+    # --------------------------------------------------------- lane engine
+
+    def _scratch_take(self, shape: tuple):
+        import jax.numpy as jnp
+
+        with self._scratch_lock:
+            bufs = self._scratch.get(shape)
+            if bufs:
+                return bufs.pop()
+        return jnp.zeros(shape, jnp.float32)
+
+    def _scratch_give(self, buf) -> None:
+        shape = tuple(buf.shape)
+        with self._scratch_lock:
+            bufs = self._scratch.setdefault(shape, [])
+            if len(bufs) < 2:
+                bufs.append(buf)
+
+    def _lane_program(self, snap: EngineSnapshot, steps: int,
+                      conditional: bool, lanes: int):
+        key = ("lanes", steps, conditional, lanes, snap.sig)
+
+        def build():
+            import jax
+
+            from fed_tgan_tpu.runtime.precision import resolve_precision
+
+            run = build_bucket_program(snap.spec, snap.cfg, snap.layout,
+                                       steps, conditional, tag=snap.tag)
+
+            def lane_run(params_g, state_g, cond, key, start, pos, tables,
+                         out):
+                return jax.vmap(run)(params_g, state_g, cond, key, start,
+                                     pos, tables, out)
+
+            prec = resolve_precision(
+                getattr(snap.cfg, "precision", "f32")).name
+            lane_run.__name__ = fleet_bucket_name(steps, conditional, prec,
+                                                  lanes, snap.tag)
+            lane_run.__qualname__ = lane_run.__name__
+            return jax.jit(lane_run, donate_argnums=7)
+
+        B = snap.cfg.batch_size
+        est = lanes * steps * B * (snap.spec.dim + len(snap.layout)) * 4
+        return self.fleet.cache.get_or_build(key, build, est_bytes=est)
+
+    def _dispatch_lanes(self, steps: int, conditional: bool,
+                        members: list) -> None:
+        """One vmapped device dispatch answering every member: per-tenant
+        params/state/cond/tables stacked on a lane axis, lane count padded
+        to a power of two (bounded program set) by repeating lane 0, whose
+        extra output is simply dropped."""
+        import jax
+        import jax.numpy as jnp
+
+        snap0 = members[0].snap
+        lanes = min(_pow2(len(members)), self.max_lanes)
+        padded = list(members) + [members[0]] * (lanes - len(members))
+        try:
+            prog = self._lane_program(snap0, steps, conditional, lanes)
+            B = snap0.cfg.batch_size
+            synths = [m.snap.model.synth for m in padded]
+            params = _stack_pytrees([s.params_g for s in synths])
+            state = _stack_pytrees([s.state_g for s in synths])
+            cond = _stack_pytrees([s.cond for s in synths])
+            keys = jnp.stack([
+                jax.random.key(m.req.seed + s.key_offset)
+                for m, s in zip(padded, synths)])
+            starts = np.asarray([m.first_step for m in padded], np.int32)
+            poss = np.asarray(
+                [m.req.condition if m.req.condition is not None else 0
+                 for m in padded], np.int32)
+            tables = _stack_pytrees([m.snap.tables for m in padded])
+            scratch = self._scratch_take(
+                (lanes, steps * B, len(snap0.layout)))
+            with hot_region(f"serve.fleet[{steps}"
+                            f"{'c' if conditional else ''}x{lanes}]"):
+                res = prog(params, state, cond, keys, starts, poss, tables,
+                           scratch)
+            host = np.asarray(res)
+            self._scratch_give(res)
+        except Exception as exc:  # noqa: BLE001 — fail the whole lane group
+            for m in members:
+                self._fail(m.req, 500, repr(exc))
+            return
+        self.metrics.record_lane_dispatch(len(members))
+        from fed_tgan_tpu.data.csvio import csv_bytes
+        from fed_tgan_tpu.data.decode import decode_matrix
+
+        for i, m in enumerate(members):
+            req = m.req
+            try:
+                mat = host[i, m.skip:m.skip + req.n]
+                frame = decode_matrix(mat, m.snap.model.meta,
+                                      m.snap.model.encoders)
+                out = csv_bytes(frame)
+                if not req.header:
+                    out = out.split(b"\n", 1)[1]
+                req.result, req.status = out, 200
+                self.metrics.record_request(
+                    req.tenant, time.time() - req.enqueued_at, req.n)
+                self._finish(req)
+            except Exception as exc:  # noqa: BLE001
+                self._fail(req, 500, repr(exc))
+
+    # -------------------------------------------------------------- reload
+
+    def _maybe_reload(self) -> None:
+        if self.reload_interval_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_reload_check < self.reload_interval_s:
+            return
+        self._last_reload_check = now
+        for name, rt in self.fleet.items():
+            try:
+                if rt.registry.maybe_reload():
+                    kept = rt.engine.adopt(rt.registry.get())
+                    self.metrics.record_reload(name)
+                    _emit_event("serve_reload", tenant=name,
+                                model_id=rt.registry.get().model_id,
+                                programs_kept=bool(kept))
+                    self._log(
+                        f"fleet: tenant {name!r} now serving model "
+                        f"{rt.registry.get().model_id} "
+                        f"({'programs kept' if kept else 'programs rebuilt'})"
+                    )
+            except Exception as exc:  # noqa: BLE001 — reload never kills serving
+                self._log(f"fleet: reload check failed for {name!r} "
+                          f"({exc!r})")
+
+    # -------------------------------------------------------------- status
+
+    def fleet_status(self) -> dict:
+        tenants = []
+        for name, rt in self.fleet.items():
+            model = rt.registry.get()
+            with self._adm_lock:
+                inflight = self._inflight.get(name, 0)
+            tenants.append({
+                "name": name,
+                "root": rt.root,
+                "model_id": model.model_id,
+                "model_name": model.artifact.name,
+                "inflight": inflight,
+                **self.metrics.tenant_snapshot(name),
+            })
+        return {
+            "status": "draining" if self._draining.is_set() else "ok",
+            "tenants": tenants,
+            "cache": self.fleet.cache.stats(),
+            "queue_depth": self.queue_depth(),
+            "tenant_cap": self.tenant_cap(),
+        }
+
+
+# ----------------------------------------------------------------- HTTP
+
+
+def _make_fleet_handler(service: FleetService):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _send(self, status: int, body: bytes, ctype: str,
+                  extra: dict | None = None) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, status: int, obj: dict,
+                       extra: dict | None = None) -> None:
+            self._send(status, json.dumps(obj).encode(), "application/json",
+                       extra)
+
+        def _tenant_for(self, path: str) -> Optional[str]:
+            """``/t/<tenant>/sample`` -> tenant name, else None."""
+            parts = path.split("/")
+            if len(parts) == 4 and parts[1] == "t" and parts[3] == "sample":
+                return urllib.parse.unquote(parts[2])
+            return None
+
+        def do_GET(self):
+            parsed = urllib.parse.urlsplit(self.path)
+            if parsed.path == "/healthz":
+                service.metrics.set_fleet_state(
+                    len(service.fleet.names()),
+                    service.fleet.cache.stats())
+                self._send_json(200, {
+                    "status": "draining" if service._draining.is_set()
+                    else "ok",
+                    "tenants": service.fleet.names(),
+                    **service.metrics.snapshot(service.queue_depth()),
+                })
+                return
+            if parsed.path == "/metrics":
+                service.metrics.set_fleet_state(
+                    len(service.fleet.names()),
+                    service.fleet.cache.stats())
+                text = service.metrics.render_prometheus(
+                    service.queue_depth())
+                self._send(200, text.encode(), "text/plain; version=0.0.4")
+                return
+            if parsed.path == "/fleet":
+                self._send_json(200, service.fleet_status())
+                return
+            tenant = self._tenant_for(parsed.path)
+            if tenant is None and parsed.path == "/sample":
+                rt = service.fleet.sole()
+                if rt is None:
+                    self._send_json(400, {
+                        "error": "/sample needs exactly one hot tenant; "
+                                 "use /t/<tenant>/sample",
+                        "tenants": service.fleet.names()})
+                    return
+                tenant = rt.name
+            if tenant is None:
+                self._send_json(404, {"error": f"no route {parsed.path}"})
+                return
+            params = {k: v[-1] for k, v in
+                      urllib.parse.parse_qs(parsed.query).items()}
+            self._handle_sample(tenant, params)
+
+        def do_POST(self):
+            parsed = urllib.parse.urlsplit(self.path)
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                params = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(params, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, json.JSONDecodeError) as exc:
+                self._send_json(400, {"error": f"bad JSON body: {exc}"})
+                return
+            if parsed.path == "/fleet":
+                self._handle_admin(params)
+                return
+            tenant = self._tenant_for(parsed.path)
+            if tenant is None and parsed.path == "/sample":
+                rt = service.fleet.sole()
+                if rt is None:
+                    self._send_json(400, {
+                        "error": "/sample needs exactly one hot tenant; "
+                                 "use /t/<tenant>/sample",
+                        "tenants": service.fleet.names()})
+                    return
+                tenant = rt.name
+            if tenant is None:
+                self._send_json(404, {"error": f"no route {parsed.path}"})
+                return
+            self._handle_sample(tenant, params)
+
+        def _handle_admin(self, params: dict) -> None:
+            action = params.get("action")
+            name = params.get("tenant")
+            if action == "load":
+                if not name or not params.get("root"):
+                    self._send_json(400, {
+                        "error": "load needs {tenant, root}"})
+                    return
+                try:
+                    rt = service.fleet.load(str(name), str(params["root"]))
+                except ArtifactError as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                self._send_json(200, {
+                    "loaded": name,
+                    "model_id": rt.registry.get().model_id})
+            elif action == "evict":
+                if not name:
+                    self._send_json(400, {"error": "evict needs {tenant}"})
+                    return
+                if service.fleet.evict(str(name)):
+                    self._send_json(200, {"evicted": name})
+                else:
+                    self._send_json(404, {
+                        "error": f"no tenant {name!r}",
+                        "tenants": service.fleet.names()})
+            else:
+                self._send_json(400, {
+                    "error": f"unknown action {action!r} "
+                             "(want load or evict)"})
+
+        def _handle_sample(self, tenant: str, params: dict) -> None:
+            rt = service.fleet.get(tenant)
+            if rt is None:
+                self._send_json(404, {
+                    "error": f"no tenant {tenant!r}",
+                    "tenants": service.fleet.names()})
+                return
+            try:
+                n = int(params.get("rows", params.get("n", 0)))
+                seed = int(params.get("seed", 0))
+                offset = int(params.get("offset", 0))
+                header = str(params.get("header", "1")) not in ("0", "false")
+                if n <= 0:
+                    raise ValueError(f"rows={n}: need a positive row count")
+                if offset < 0:
+                    raise ValueError(f"offset={offset}: must be >= 0")
+            except (TypeError, ValueError) as exc:
+                self._send_json(400, {"error": str(exc)})
+                return
+            condition = None
+            column = params.get("column")
+            if column:
+                try:
+                    condition = rt.engine.resolve_condition(
+                        column, params.get("value"))
+                except ConditionError as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+            req = _FleetRequest(tenant=tenant, n=n, seed=seed, offset=offset,
+                                condition=condition, header=header)
+            shed = service.submit(rt, req)
+            if shed == "quota":
+                retry = max(rt.bucket.retry_after_s(), 0.05)
+                self._send_json(
+                    429, {"error": f"tenant {tenant!r} over quota"},
+                    extra={"Retry-After": f"{retry:.2f}"})
+                return
+            if shed is not None:
+                self._send_json(
+                    503,
+                    {"error": "draining" if service._draining.is_set()
+                     else "at capacity"},
+                    extra={"Retry-After": "1"},
+                )
+                return
+            if not req.done.wait(timeout=service.request_timeout_s):
+                self._send_json(504, {"error": "request timed out in queue"})
+                return
+            if req.status == 200 and req.result is not None:
+                self._send(200, req.result, "text/csv")
+            else:
+                self._send_json(req.status, {"error": req.error or "failed"})
+
+    return Handler
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def fleet_main(argv=None) -> int:
+    """``fed-tgan-tpu fleet name=artifact-dir [name=dir ...] [flags]``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="fed_tgan_tpu fleet",
+        description="serve MANY model artifacts over one HTTP server with "
+                    "cross-tenant program sharing and per-tenant quotas")
+    ap.add_argument("tenants", nargs="+", metavar="NAME=DIR",
+                    help="tenant name and its artifact root (same "
+                         "resolution as --sample-from)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7799,
+                    help="0 = ephemeral (printed at startup)")
+    ap.add_argument("--max-batch", type=int, default=16,
+                    help="max requests coalesced per worker cycle")
+    ap.add_argument("--queue-size", type=int, default=128,
+                    help="bounded request queue; full = shed with 503")
+    ap.add_argument("--max-lanes", type=int, default=8,
+                    help="max tenants coalesced into one vmapped dispatch "
+                         "(1 disables cross-tenant coalescing)")
+    ap.add_argument("--queue-share", type=float, default=0.5,
+                    help="fraction of the queue one tenant may hold "
+                         "in-flight before 503 (fair shedding)")
+    ap.add_argument("--quota-rps", type=float, default=0.0,
+                    help="per-tenant admission quota in requests/second "
+                         "(0 = unlimited); over-quota requests get 429")
+    ap.add_argument("--quota-burst", type=float, default=None,
+                    help="per-tenant token-bucket burst (default: "
+                         "max(quota-rps, 1))")
+    ap.add_argument("--cache-entries", type=int, default=64,
+                    help="compiled-program LRU entry budget")
+    ap.add_argument("--cache-mb", type=float, default=256.0,
+                    help="compiled-program LRU byte budget (estimated)")
+    ap.add_argument("--request-timeout", type=float, default=120.0,
+                    help="seconds a request may wait before 504")
+    ap.add_argument("--reload-interval", type=float, default=5.0,
+                    help="seconds between per-tenant hot-reload polls "
+                         "(0 = never)")
+    ap.add_argument("--allow-meta-mismatch", action="store_true",
+                    help="serve even when a meta JSON postdates its "
+                         "synthesizer (see --sample-from)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="runtime sanitizers: transfer guards on the lane "
+                         "dispatch + a one-compile-per-program budget over "
+                         "the shared LRU (exit 4 on violation)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    pairs = []
+    for spec in args.tenants:
+        name, sep, root = spec.partition("=")
+        if not sep or not name or not root:
+            ap.error(f"tenant spec {spec!r}: want NAME=DIR")
+        pairs.append((name, root))
+
+    from fed_tgan_tpu.cli import _enable_compile_cache
+
+    _enable_compile_cache()
+    if args.sanitize:
+        from fed_tgan_tpu.analysis.sanitizers import enable_sanitizers
+
+        enable_sanitizers()
+    log = (lambda *a, **k: None) if args.quiet else print
+    fleet = FleetRegistry(
+        program_cache=ProgramCache(max_entries=args.cache_entries,
+                                   max_bytes=int(args.cache_mb * 1024
+                                                 * 1024)),
+        quota_rps=args.quota_rps, quota_burst=args.quota_burst,
+        allow_meta_mismatch=args.allow_meta_mismatch, log=log,
+    )
+    for name, root in pairs:
+        try:
+            fleet.load(name, root)
+        except ArtifactError as exc:
+            print(f"fleet: tenant {name!r}: {exc}")
+            return 2
+    service = FleetService(
+        fleet, host=args.host, port=args.port, max_batch=args.max_batch,
+        queue_size=args.queue_size, max_lanes=args.max_lanes,
+        queue_share=args.queue_share,
+        request_timeout_s=args.request_timeout,
+        reload_interval_s=args.reload_interval, log=log,
+    )
+    service.start()
+    print(f"serving {len(pairs)} tenant(s) on {service.url}  "
+          f"(endpoints: /t/<tenant>/sample /fleet /healthz /metrics; "
+          "Ctrl-C drains and exits)", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("fleet: draining...", flush=True)
+        service.shutdown(drain=True)
+    if args.sanitize:
+        from fed_tgan_tpu.analysis import sanitizers
+
+        print(sanitizers.compile_report())
+        problems = sanitizers.check_fleet_budget(fleet.cache)
+        for problem in problems:
+            print(f"SANITIZE: {problem}")
+        if problems:
+            return 4
+    return 0

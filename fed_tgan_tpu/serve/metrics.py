@@ -16,6 +16,7 @@ warm-up: the obs registry is pure stdlib by contract.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
@@ -139,3 +140,161 @@ class ServiceMetrics:
             lines.append(f"# TYPE {prefix}_{key} {kind}")
             lines.append(f"{prefix}_{key} {value}")
         return "\n".join(lines) + "\n"
+
+
+class FleetMetrics:
+    """Per-tenant labeled counters for one :class:`~.fleet.FleetService`.
+
+    Every request-path metric carries a ``tenant`` label (one series per
+    tenant on a shared base name, the PR 6 registry's label support), so
+    a single ``/metrics`` scrape separates the tenants; sheds addition-
+    ally carry ``reason`` ∈ {quota, capacity} — the 429/503 split is an
+    admission contract and the metric must be able to prove which side
+    fired.  Fleet-level gauges (cache occupancy, tenant count) are
+    pushed in at scrape time from the LRU cache's own stats.
+    """
+
+    #: per-tenant latency buckets: serving answers in ms-to-seconds
+    LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                       0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, reservoir: int = 4096,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.started_at = time.time()
+        self.reservoir = int(reservoir)
+        self._tenants: dict = {}   # name -> per-tenant metric bundle
+        self._tlock = threading.Lock()
+        self._batches = self.registry.counter(
+            "batches_total", "worker micro-batches executed")
+        self._lane_dispatches = self.registry.counter(
+            "lane_dispatches_total",
+            "coalesced multi-tenant device dispatches")
+        self._lane_requests = self.registry.counter(
+            "lane_requests_total",
+            "requests answered via a coalesced lane dispatch")
+        self._cache_entries = self.registry.gauge(
+            "program_cache_entries", "compiled programs held by the LRU")
+        self._cache_bytes = self.registry.gauge(
+            "program_cache_bytes", "estimated bytes held by the LRU")
+        self._cache_hits = self.registry.gauge(
+            "program_cache_hits_total", "LRU lookups served from cache")
+        self._cache_misses = self.registry.gauge(
+            "program_cache_misses_total", "LRU lookups that built")
+        self._cache_evictions = self.registry.gauge(
+            "program_cache_evictions_total", "LRU entries evicted")
+        self._tenant_gauge = self.registry.gauge(
+            "tenants", "tenant models currently hot")
+
+    def _bundle(self, tenant: str) -> dict:
+        with self._tlock:
+            b = self._tenants.get(tenant)
+            if b is None:
+                lab = {"tenant": tenant}
+                reg = self.registry
+                b = {
+                    "requests": reg.counter(
+                        "requests_total", "sampling requests answered",
+                        labels=lab),
+                    "rows": reg.counter(
+                        "rows_total", "synthetic rows returned", labels=lab),
+                    "errors": reg.counter(
+                        "errors_total", "requests failed", labels=lab),
+                    "reloads": reg.counter(
+                        "reloads_total", "model hot reloads", labels=lab),
+                    "shed_quota": reg.counter(
+                        "shed_total", "requests shed at admission",
+                        labels={"tenant": tenant, "reason": "quota"}),
+                    "shed_capacity": reg.counter(
+                        "shed_total", "requests shed at admission",
+                        labels={"tenant": tenant, "reason": "capacity"}),
+                    "latency": reg.histogram(
+                        "latency_seconds", "request latency (s)",
+                        buckets=self.LATENCY_BUCKETS,
+                        reservoir=self.reservoir, labels=lab),
+                }
+                self._tenants[tenant] = b
+            return b
+
+    # ---------------------------------------------------------- record
+
+    def record_batch(self, n_requests: int) -> None:
+        self._batches.inc()
+
+    def record_lane_dispatch(self, n_requests: int) -> None:
+        self._lane_dispatches.inc()
+        self._lane_requests.inc(n_requests)
+
+    def record_request(self, tenant: str, latency_s: float,
+                       rows: int) -> None:
+        b = self._bundle(tenant)
+        b["requests"].inc()
+        b["rows"].inc(rows)
+        b["latency"].observe(latency_s)
+
+    def record_shed(self, tenant: str, reason: str) -> None:
+        b = self._bundle(tenant)
+        b["shed_quota" if reason == "quota" else "shed_capacity"].inc()
+
+    def record_error(self, tenant: str) -> None:
+        self._bundle(tenant)["errors"].inc()
+
+    def record_reload(self, tenant: str) -> None:
+        self._bundle(tenant)["reloads"].inc()
+
+    def set_fleet_state(self, n_tenants: int, cache_stats: dict) -> None:
+        self._tenant_gauge.set(n_tenants)
+        self._cache_entries.set(cache_stats.get("entries", 0))
+        self._cache_bytes.set(cache_stats.get("bytes", 0))
+        self._cache_hits.set(cache_stats.get("hits", 0))
+        self._cache_misses.set(cache_stats.get("misses", 0))
+        self._cache_evictions.set(cache_stats.get("evictions", 0))
+
+    # --------------------------------------------------------- export
+
+    def tenant_snapshot(self, tenant: str) -> dict:
+        b = self._bundle(tenant)
+        lat = b["latency"].reservoir_values()
+        return {
+            "requests_total": int(b["requests"].value),
+            "rows_total": int(b["rows"].value),
+            "errors_total": int(b["errors"].value),
+            "reloads_total": int(b["reloads"].value),
+            "shed_quota_total": int(b["shed_quota"].value),
+            "shed_capacity_total": int(b["shed_capacity"].value),
+            "latency_p50_ms": round(_quantile(lat, 0.50) * 1e3, 2),
+            "latency_p99_ms": round(_quantile(lat, 0.99) * 1e3, 2),
+        }
+
+    def snapshot(self, queue_depth: int = 0) -> dict:
+        with self._tlock:
+            names = sorted(self._tenants)
+        per_tenant = {name: self.tenant_snapshot(name) for name in names}
+        uptime = max(time.time() - self.started_at, 1e-9)
+        requests = sum(t["requests_total"] for t in per_tenant.values())
+        rows = sum(t["rows_total"] for t in per_tenant.values())
+        batches = int(self._batches.value)
+        return {
+            "uptime_s": round(uptime, 3),
+            "requests_total": requests,
+            "rows_total": rows,
+            "batches_total": batches,
+            "lane_dispatches_total": int(self._lane_dispatches.value),
+            "lane_requests_total": int(self._lane_requests.value),
+            "queue_depth": queue_depth,
+            "batch_occupancy": round(requests / batches, 3)
+            if batches else 0.0,
+            "rows_per_sec": round(rows / uptime, 1),
+            "tenants": per_tenant,
+        }
+
+    def render_prometheus(self, queue_depth: int = 0,
+                          prefix: str = "fed_tgan_fleet") -> str:
+        # the registry already renders every labeled series; add the two
+        # queue/uptime gauges the registry doesn't own
+        head = (f"# TYPE {prefix}_queue_depth gauge\n"
+                f"{prefix}_queue_depth {queue_depth}\n"
+                f"# TYPE {prefix}_uptime_s gauge\n"
+                f"{prefix}_uptime_s "
+                f"{max(time.time() - self.started_at, 0.0):g}\n")
+        return head + self.registry.render_prometheus()

@@ -30,3 +30,32 @@ def serve_bucket_name(n_steps: int, conditional: bool,
     suffix = "" if precision == "f32" else f"_{precision}"
     return (f"{SERVE_BUCKET_PREFIX}{int(n_steps)}"
             f"{'_cond' if conditional else ''}{suffix}")
+
+
+def layout_tag(layout_key) -> str:
+    """8-hex content tag of a fleet layout key (any repr-stable value).
+
+    The fleet's shared program cache keys compiled programs by the full
+    trace identity — encoded layout, decode layout, batch/embedding/
+    generator dims, precision — so tenants with the SAME tag share one
+    compiled program per bucket while different-schema tenants get
+    distinct program names (and the compile budget can still assert
+    "<= one compile per name")."""
+    import hashlib
+
+    return hashlib.sha1(repr(layout_key).encode()).hexdigest()[:8]
+
+
+def fleet_bucket_name(n_steps: int, conditional: bool,
+                      precision: str = "f32", lanes: int = 1,
+                      tag: str | None = None) -> str:
+    """Program name for a fleet bucket: the single-model bucket name plus
+    a ``_xL`` lane-width suffix for vmapped cross-tenant dispatches and a
+    ``_L<tag>`` layout tag.  ``lanes=1, tag=None`` reduces exactly to
+    :func:`serve_bucket_name` (the contracts' stable keys)."""
+    name = serve_bucket_name(n_steps, conditional, precision)
+    if lanes > 1:
+        name += f"_x{int(lanes)}"
+    if tag is not None:
+        name += f"_L{tag}"
+    return name

@@ -188,11 +188,15 @@ class SamplingService:
         if not batch:
             return
         self.metrics.record_batch(len(batch))
+        # one snapshot for the whole formed batch: a hot reload adopting a
+        # new model mid-batch must never swap the model out from under
+        # requests already grouped against the old one
+        snap = self.engine.snapshot()
         for req in batch:
             try:
                 req.result = self.engine.sample_csv_bytes(
                     req.n, seed=req.seed, offset=req.offset,
-                    condition=req.condition, header=req.header,
+                    condition=req.condition, header=req.header, snap=snap,
                 )
                 req.status = 200
                 self.metrics.record_request(
