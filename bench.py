@@ -1058,6 +1058,152 @@ def bench_scale(epochs: int = 50, n_clients: int = 32,
     return out
 
 
+def bench_scale_cohort(cohort: int = 64,
+                       populations: tuple = (64, 256, 1024),
+                       epochs: int = 20, rows_per_client: int = 200,
+                       bgm_backend: str = "jax",
+                       shard_strategy: str = "iid", alpha: float = 0.5,
+                       quality: bool = False,
+                       obs_dir: str | None = "bench_obs_scale") -> dict:
+    """ROADMAP item 1's thousand-client round: sweep the resident client
+    population N at a FIXED per-round cohort C and show round time is
+    sub-linear in N (the acceptance bar: N 64 -> 1024 grows far less than
+    16x).  Every population keeps the same rows per client so each
+    sampled client does identical local work — what changes with N is
+    only the resident state, which cohort sampling keeps off the round's
+    critical path (compute, collective payload O(C) + O(model); the
+    hlolint ``cohort_rounds`` family asserts the collective half at
+    lowering time).  N=64 with C=64 is full participation — the legacy
+    program — so the sweep's first point doubles as the baseline.
+
+    The model is deliberately small (the sweep measures federation
+    overhead, not GAN FLOPs; dims are recorded in the output) and the
+    telemetry layer rides along exactly as in ``bench_round``: the
+    journal's per-round ``cohort`` events and the host-phase attribution
+    table land in ``obs_dir`` / the returned dict.  ``quality=True``
+    additionally scores Avg_JSD/Avg_WD of a 20k-row sample against the
+    train table at each N (the NONIID_SWEEP extension hook, with
+    ``shard_strategy="dirichlet"`` for the label-skew regime)."""
+    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.obs import (RunJournal, get_registry, set_journal,
+                                  start_tracing, stop_tracing)
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    journal = tracer = None
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        journal = RunJournal(os.path.join(obs_dir, "journal.jsonl"),
+                             run_id="bench_scale_cohort")
+        set_journal(journal)
+        tracer = start_tracing()
+    try:
+        sweep = {}
+        t_all = time.time()
+        for n in populations:
+            t_start = time.time()
+            df = _covertype_like(n * rows_per_client)
+            clients = [
+                TablePreprocessor(
+                    frame=f, name="CovertypeCohort",
+                    categorical_columns=["Wilderness_Area", "Soil_Type",
+                                         "Cover_Type"],
+                    target_column="Cover_Type",
+                    problem_type="multiclass_classification",
+                )
+                for f in shard_dataframe(
+                    df, n, shard_strategy,
+                    label_column=("Cover_Type" if shard_strategy in
+                                  ("label_sorted", "dirichlet") else None),
+                    alpha=alpha, seed=0)
+            ]
+            init = federated_initialize(clients, seed=0, weighted=True,
+                                        backend=bgm_backend)
+            cfg = TrainConfig(embedding_dim=16, gen_dims=(32,),
+                              dis_dims=(32,), batch_size=40, pac=4,
+                              cohort=min(cohort, n),
+                              # label-skewed shards at N=1024 leave some
+                              # clients under one batch of rows; they hold
+                              # weight but skip local compute
+                              allow_zero_step_clients=(
+                                  shard_strategy != "iid"))
+            trainer = FederatedTrainer(init, config=cfg, seed=0)
+            t_init = time.time() - t_start
+            # warmup compiles every fused-chunk shape the timed run uses
+            tail = epochs % 16 or 16
+            trainer.fit(epochs if epochs <= 16 else 16 + tail)
+            t0 = time.time()
+            trainer.fit(epochs)
+            per_round = (time.time() - t0) / epochs
+            entry = {
+                "per_round_s": round(per_round, 4),
+                "cohort": int(min(cohort, n)),
+                "full_participation": cohort >= n,
+                "steps_per_client_per_round": int(trainer.max_steps),
+                "init_seconds": round(t_init, 2),
+            }
+            if quality:
+                from fed_tgan_tpu.eval.similarity import (
+                    statistical_similarity,
+                )
+
+                cols = init.global_meta.column_names
+                raw = decode_matrix(trainer.sample(20_000, seed=1),
+                                    init.global_meta, init.encoders)
+                jsd, wd, _ = statistical_similarity(
+                    df[cols], raw, init.global_meta.categorical_columns)
+                entry["final_avg_jsd"] = round(float(jsd), 4)
+                entry["final_avg_wd"] = round(float(wd), 4)
+            sweep[f"n{n}"] = entry
+        lo, hi = min(populations), max(populations)
+        ratio = sweep[f"n{hi}"]["per_round_s"] / max(
+            sweep[f"n{lo}"]["per_round_s"], 1e-9)
+        result = {
+            "metric": (f"covertype_cohort{cohort}_population_sweep_round_"
+                       f"seconds"
+                       + ("" if shard_strategy == "iid"
+                          else f"({shard_strategy}-a{alpha})")),
+            # headline value: the 1024-client (max-N) steady-state round
+            "value": sweep[f"n{hi}"]["per_round_s"],
+            "unit": (f"s/round at N={hi} with cohort C={cohort} (fused, "
+                     "snapshot-free; vs_baseline is 0 by convention — no "
+                     "reference comparator exists at this scale)"),
+            "vs_baseline": 0,
+            "populations": list(populations),
+            "rows_per_client": rows_per_client,
+            "epochs_per_population": epochs,
+            "sweep": sweep,
+            # the ROADMAP acceptance figure: N grew hi/lo x, round time
+            # grew only this factor
+            "population_growth": round(hi / lo, 1),
+            "round_time_growth": round(ratio, 3),
+            "sublinear": bool(ratio < hi / lo),
+            "model_dims": {"embedding_dim": 16, "gen_dims": [32],
+                           "dis_dims": [32], "batch_size": 40, "pac": 4},
+            "total_seconds": round(time.time() - t_all, 1),
+        }
+        if obs_dir:
+            trace_path = tracer.export(os.path.join(obs_dir, "trace.json"))
+            metrics_path = os.path.join(obs_dir, "metrics.prom")
+            with open(metrics_path, "w") as f:
+                f.write(get_registry().render_prometheus())
+            result["obs"] = {
+                "journal": journal.path,
+                "trace": trace_path,
+                "metrics": metrics_path,
+                "host_phases": tracer.phase_summary(),
+            }
+        return result
+    finally:
+        if obs_dir:
+            set_journal(None)
+            journal.close()
+            stop_tracing()
+
+
 def bench_multihost(epochs: int = 10) -> dict:
     """The reference's ACTUAL deployment shape: rank 0 + 2 client ranks as
     separate processes over TCP/gloo on localhost — its 24.26 s/epoch
@@ -1476,6 +1622,16 @@ def main() -> int:
                     help="participants (default: 2; the scale workload "
                          "defaults to 32 — BASELINE.md configs 2/3 use 8, "
                          "config 5 uses 32)")
+    ap.add_argument("--cohort", type=int, default=0, metavar="C",
+                    help="scale workload: per-round cohort size — instead "
+                         "of the single-N full-participation bench, sweep "
+                         "the resident client population N over "
+                         "{64, 256, 1024} at this fixed C and report "
+                         "s/round per N plus the 64->1024 round-time "
+                         "growth factor (ROADMAP item 1's thousand-client "
+                         "demo: round cost O(C) + O(model), N-independent; "
+                         "0 = off).  C must be a multiple of the device "
+                         "count")
     ap.add_argument("--target-requests", type=int, default=100_000,
                     help="serving-fleet workload: sustained-window request "
                          "target across all tenants (default 100k)")
@@ -1649,6 +1805,14 @@ def main() -> int:
         ap.error(f"--precision {args.precision} only applies to the "
                  f"round/full500/utility/serving workloads "
                  f"(got {args.workload})")
+    if args.cohort < 0:
+        ap.error(f"--cohort {args.cohort}: must be >= 0")
+    if args.cohort and args.workload != "scale":
+        ap.error(f"--cohort only applies to --workload scale "
+                 f"(got {args.workload})")
+    if args.cohort and (args.clients is not None or args.rows is not None):
+        ap.error("--cohort sweeps fixed populations {64, 256, 1024} with "
+                 "fixed rows per client; --clients/--rows do not apply")
     if args.target_requests < 1:
         ap.error(f"--target-requests {args.target_requests}: must be >= 1")
     if args.fleet_duration <= 0:
@@ -1806,6 +1970,11 @@ def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
     if args.workload == "multihost":
         return bench_multihost(epochs)
     if args.workload == "scale":
+        if args.cohort:
+            return bench_scale_cohort(
+                cohort=args.cohort, epochs=epochs, bgm_backend=bgm,
+                shard_strategy=shard_strategy, alpha=args.alpha,
+                quality=args.quality)
         return bench_scale(epochs, n_clients=clients,
                            rows=rows, bgm_backend=bgm,
                            quality=args.quality)

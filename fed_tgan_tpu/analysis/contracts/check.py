@@ -247,7 +247,12 @@ def check_requirements(family: str, program: str, require: dict,
       the statement "logical collective traffic scales ``rounds`` × the
       single-round program": IR totals growing means the scan unrolled
       into per-round collectives, any other delta means the per-round
-      aggregation payload re-widened.
+      aggregation payload re-widened;
+    * ``collective_bytes_independent {vs}``: IR collective bytes must
+      EQUAL the named smallest-population sibling's.  Under cohort
+      sampling the round payload is O(cohort) + O(model) regardless of
+      how many client shards are resident, so the lowered collective
+      totals must not move as the population N grows at fixed cohort C.
     """
     issues: List[Issue] = []
     fp = programs[program]
@@ -317,6 +322,28 @@ def check_requirements(family: str, program: str, require: dict,
                     message=f"IR collective bytes must equal the single-"
                             f"round program so logical traffic scales "
                             f"exactly {k_rounds}x ({hint})"))
+    indep_req = require.get("collective_bytes_independent")
+    if indep_req:
+        vs = indep_req["vs"]
+        if vs not in programs:
+            issues.append(Issue(
+                severity=REGRESSION, family=family, program=program,
+                metric="require.collective_bytes_independent",
+                old=vs, new="missing",
+                message="smallest-population baseline for the cohort "
+                        "N-independence requirement is no longer lowered"))
+        else:
+            mine = total_collective_bytes(fp)
+            base = total_collective_bytes(programs[vs])
+            if mine != base:
+                issues.append(Issue(
+                    severity=REGRESSION, family=family, program=program,
+                    metric="require.collective_bytes_independent",
+                    old=f"== {base} ({vs})", new=mine,
+                    message="cohort-round collective bytes must be "
+                            "independent of the client population N at "
+                            "fixed cohort C (accidental all_gather/psum "
+                            "over the population axis?)"))
     return issues
 
 
@@ -365,6 +392,7 @@ def diff_contracts(current: Dict[str, Dict[str, Fingerprint]],
 _FAMILY_DIRS = {
     "train_federated": ("train", "parallel", "ops", "models"),
     "fused_rounds": ("train", "parallel", "ops", "models"),
+    "cohort_rounds": ("train", "parallel", "ops", "models"),
     "parallel_fedavg": ("parallel",),
     "serve_engine": ("serve", "ops", "models"),
 }

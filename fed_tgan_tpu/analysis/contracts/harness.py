@@ -117,20 +117,20 @@ def _toy_matrix(spec, seed: int, rows: int = _ROWS) -> np.ndarray:
     return mat
 
 
-def _client_stacks(spec, cfg):
+def _client_stacks(spec, cfg, n_clients: int = N_DEVICES):
     from fed_tgan_tpu.train.federated import _stack_samplers
     from fed_tgan_tpu.train.sampler import CondSampler, RowSampler
 
-    mats = [_toy_matrix(spec, seed=i) for i in range(N_DEVICES)]
+    mats = [_toy_matrix(spec, seed=i) for i in range(n_clients)]
     cond = _stack_samplers([CondSampler.from_data(m, spec) for m in mats])
     rows = _stack_samplers([RowSampler.from_data(m, spec) for m in mats])
     data = np.stack(mats)
-    steps = np.full((N_DEVICES,), _ROWS // cfg.batch_size, dtype=np.int32)
-    weights = np.full((N_DEVICES,), 1.0 / N_DEVICES, dtype=np.float32)
+    steps = np.full((n_clients,), _ROWS // cfg.batch_size, dtype=np.int32)
+    weights = np.full((n_clients,), 1.0 / n_clients, dtype=np.float32)
     return data, cond, rows, steps, weights
 
 
-def _stacked_models(spec, cfg):
+def _stacked_models(spec, cfg, n_clients: int = N_DEVICES):
     import jax
 
     from fed_tgan_tpu.train.steps import init_models
@@ -138,7 +138,7 @@ def _stacked_models(spec, cfg):
     one = init_models(jax.random.key(0), spec, cfg)
     return one, jax.tree.map(
         lambda x: np.broadcast_to(
-            np.asarray(x)[None], (N_DEVICES,) + np.shape(x)).copy(),
+            np.asarray(x)[None], (n_clients,) + np.shape(x)).copy(),
         one,
     )
 
@@ -212,6 +212,43 @@ def _lower_fused_rounds(k_rounds: int, precision: str = "f32"):
     _one, models = _stacked_models(spec, cfg)
     fn = make_federated_epoch(spec, cfg, max_steps=int(steps.max()),
                               mesh=mesh, k=1, rounds=k_rounds)
+    return fn.lower(models, data, cond, rows, steps, weights,
+                    jax.random.key(0))
+
+
+#: fixed cohort size for the cohort_rounds family: every population is
+#: sampled down to the SAME per-round cohort, so the lowered programs'
+#: collective totals must be byte-identical across N (the O(C) + O(model)
+#: round-payload invariant).
+_COHORT_C = 8
+
+
+def _lower_cohort(n_clients: int):
+    """Cohort-sampled partial participation at population ``n_clients``
+    (packed ``k = n_clients / N_DEVICES`` per device), cohort fixed at
+    ``_COHORT_C`` — the exact trainer program ``--cohort C`` compiles.
+
+    Per-round collectives under cohort sampling are one scalar psum (the
+    cohort weight renormalization), the model-sized aggregation psum, and
+    the gate's cohort-sized scalar all_gathers — all independent of the
+    resident population N.  The ``collective_bytes_independent`` require
+    block below pins that: collective totals growing with N means
+    something collected over the population axis instead of the cohort
+    slice."""
+    import jax
+
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.train.federated import make_federated_epoch
+
+    require_mesh()
+    spec = _toy_spec()
+    cfg = _toy_cfg(cohort=_COHORT_C)
+    mesh = client_mesh(N_DEVICES)
+    k = n_clients // N_DEVICES
+    data, cond, rows, steps, weights = _client_stacks(spec, cfg, n_clients)
+    _one, models = _stacked_models(spec, cfg, n_clients)
+    fn = make_federated_epoch(spec, cfg, max_steps=int(steps.max()),
+                              mesh=mesh, k=k, rounds=2)
     return fn.lower(models, data, cond, rows, steps, weights,
                     jax.random.key(0))
 
@@ -396,6 +433,10 @@ ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
            (lambda k=k: _lower_fused_rounds(k, "bf16"))
            for k in (1, 2, 4)},
     },
+    "cohort_rounds": {
+        f"cohort_rounds[n{n}]": (lambda n=n: _lower_cohort(n))
+        for n in (16, 32, 64)
+    },
     "parallel_fedavg": {
         "fedavg[weighted_psum]": _lower_weighted_psum,
         "fedavg[weighted_delta_bf16]": _lower_weighted_delta,
@@ -439,7 +480,12 @@ ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
 #:   rounds invariant (collectives inside ``lax.scan`` lower once, so
 #:   logical traffic is exactly ``rounds`` × the baseline iff the IR
 #:   totals match; growth = scan unrolled, other deltas = per-round
-#:   payload re-widened).
+#:   payload re-widened);
+#: * ``collective_bytes_independent {vs}``: the program's IR collective
+#:   bytes must EQUAL the named smallest-population sibling's — the
+#:   cohort-federation invariant (round collective payload is O(cohort)
+#:   + O(model), independent of the resident client population N;
+#:   growth with N = something collected over the population axis).
 PROGRAM_REQUIREMENTS: Dict[str, Dict[str, dict]] = {
     "train_federated": {
         "fused_epoch[weighted@bf16]": {
@@ -466,6 +512,11 @@ PROGRAM_REQUIREMENTS: Dict[str, Dict[str, dict]] = {
             "collective_bytes_scale": {"vs": "fused_rounds[1@bf16]",
                                        "rounds": k},
            } for k in (2, 4)},
+    },
+    "cohort_rounds": {
+        f"cohort_rounds[n{n}]": {
+            "collective_bytes_independent": {"vs": "cohort_rounds[n16]"},
+        } for n in (32, 64)
     },
     "parallel_fedavg": {
         "fedavg[weighted_delta_bf16]": {

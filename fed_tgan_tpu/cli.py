@@ -71,6 +71,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-report", "--report", action="store_true",
                    help="accepted for drop-in compatibility (reference -report)")
     p.add_argument("--n-clients", type=int, default=None)
+    p.add_argument("--population", type=int, default=None,
+                   help="total resident client population N (alias of "
+                        "--n-clients, named for cohort-federation runs): "
+                        "all N shards stay packed on the device mesh; "
+                        "per-round compute and collective payload follow "
+                        "--cohort, not N")
+    p.add_argument("--cohort", type=int, default=0,
+                   help="clients sampled per round (C): each round draws a "
+                        "deterministic, key-derived cohort of C of the N "
+                        "resident clients on device and runs local training "
+                        "+ aggregation over their fixed-shape slices only, "
+                        "with similarity weights renormalized over the "
+                        "cohort — round cost is O(C) + O(model), "
+                        "independent of N.  C must be a multiple of the "
+                        "device count.  0 (default) or C = N = full "
+                        "participation, bit-identical to the pre-cohort "
+                        "program")
+    p.add_argument("--aggregation", type=str, default="sync",
+                   choices=["sync", "buffered"],
+                   help="sync = every participating client's update lands "
+                        "in its own round (barrier semantics; default).  "
+                        "buffered = scripted stragglers (--faults "
+                        "straggle:rank=R,delay=D) skip the round barrier "
+                        "and their deltas land D rounds later, discounted "
+                        "by staleness_discount^staleness, screened by the "
+                        "same finite/quarantine gate; with no straggler "
+                        "active, bit-identical to sync")
     p.add_argument("--shard-strategy", type=str, default="iid",
                    choices=["iid", "contiguous", "label_sorted", "dirichlet"])
     p.add_argument("--alpha", type=float, default=0.5, help="dirichlet skew")
@@ -597,6 +624,24 @@ def main(argv=None) -> int:
         parser.error("--ema-decay is only supported in fedavg mode "
                      "(single-program or multi-process), not "
                      "mdgan/standalone")
+    if args.population is not None:
+        if args.n_clients is not None and args.n_clients != args.population:
+            parser.error(f"--population {args.population} conflicts with "
+                         f"--n-clients {args.n_clients} (they are aliases; "
+                         "pass one)")
+        args.n_clients = args.population
+    if args.cohort < 0:
+        parser.error(f"--cohort {args.cohort}: must be >= 0")
+    multihost_launch = args.rank is not None and bool(args.ip)
+    if args.cohort and (args.mode != "fedavg" or multihost_launch):
+        parser.error("--cohort needs the in-process fedavg trainer (the "
+                     "cohort is sampled across the packed client axis; the "
+                     "multihost harness holds one client per process)")
+    if args.aggregation == "buffered" and (args.mode != "fedavg"
+                                           or multihost_launch):
+        parser.error("--aggregation buffered needs the in-process fedavg "
+                     "trainer (buffered deltas are re-applied by the host "
+                     "training loop)")
 
     if args.decode:
         # the trainers read the selection at construction time via
@@ -737,7 +782,9 @@ def main(argv=None) -> int:
                       gate_norm_factor=args.gate_norm_factor,
                       update_clip=args.update_clip,
                       trim_ratio=args.trim_ratio,
-                      precision=args.precision)
+                      precision=args.precision,
+                      cohort=args.cohort,
+                      aggregation=args.aggregation)
     if args.mode == "standalone":
         # no participants, no harmonization/refit protocol — skip the
         # federated construction entirely

@@ -380,6 +380,87 @@ def check_scan_rounds(timeout: int = 300) -> bool:
                  "to 2 sequential dispatches")
 
 
+def check_cohort_scale(timeout: int = 300) -> bool:
+    """Cohort-sampled partial participation holds its two load-bearing
+    properties.
+
+    A subprocess (lowering must own backend init, like the contract gate)
+    lowers ``cohort_rounds[n16]`` next to ``cohort_rounds[n64]`` — the
+    same cohort C over a 4x larger resident population — and asserts the
+    contract require block's invariant directly: IR collective bytes are
+    EQUAL (the round payload is O(cohort) + O(model); growth with N means
+    something collected over the population axis).  It then lowers the
+    C=N configuration next to the cohort=0 legacy program and asserts the
+    StableHLO text is byte-identical — full participation must remain the
+    exact pre-cohort program, which is what makes ``--cohort`` safe to
+    default off."""
+    import json
+    import subprocess
+
+    code = (
+        "import json\n"
+        "import jax\n"
+        "from fed_tgan_tpu.analysis.contracts.harness import (\n"
+        "    ENTRYPOINT_FAMILIES, N_DEVICES, require_mesh,\n"
+        "    _client_stacks, _stacked_models, _toy_cfg, _toy_spec)\n"
+        "from fed_tgan_tpu.analysis.contracts.ir import (\n"
+        "    fingerprint_text, total_collective_bytes)\n"
+        "require_mesh()\n"
+        "fams = ENTRYPOINT_FAMILIES['cohort_rounds']\n"
+        "out = {}\n"
+        "for name in ('cohort_rounds[n16]', 'cohort_rounds[n64]'):\n"
+        "    fp = fingerprint_text(fams[name]().as_text())\n"
+        "    out[name] = total_collective_bytes(fp)\n"
+        "from fed_tgan_tpu.parallel.mesh import client_mesh\n"
+        "from fed_tgan_tpu.train.federated import make_federated_epoch\n"
+        "spec = _toy_spec()\n"
+        "mesh = client_mesh(N_DEVICES)\n"
+        "texts = []\n"
+        "for cohort in (0, 2 * N_DEVICES):\n"
+        "    cfg = _toy_cfg(cohort=cohort)\n"
+        "    data, cond, rows, steps, weights = _client_stacks(\n"
+        "        spec, cfg, 2 * N_DEVICES)\n"
+        "    _one, models = _stacked_models(spec, cfg, 2 * N_DEVICES)\n"
+        "    fn = make_federated_epoch(spec, cfg,\n"
+        "        max_steps=int(steps.max()), mesh=mesh, k=2, rounds=2)\n"
+        "    texts.append(fn.lower(models, data, cond, rows, steps,\n"
+        "                          weights, jax.random.key(0)).as_text())\n"
+        "out['full_participation_identical'] = texts[0] == texts[1]\n"
+        "print(json.dumps(out))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "cohort-scale", f"timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return _line(False, "cohort-scale",
+                     " | ".join(tail) or "lowering failed")
+    try:
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        b16, b64 = res["cohort_rounds[n16]"], res["cohort_rounds[n64]"]
+    except Exception as exc:
+        return _line(False, "cohort-scale", f"unparseable result: {exc!r}")
+    if b64 != b16:
+        return _line(False, "cohort-scale",
+                     f"cohort_rounds[n64] collectives move {b64}B vs "
+                     f"cohort_rounds[n16] {b16}B — must be EQUAL "
+                     "(collected over the population axis?)")
+    if not res.get("full_participation_identical"):
+        return _line(False, "cohort-scale",
+                     "cohort=N program is NOT byte-identical to the "
+                     "cohort=0 legacy program — full participation drifted")
+    return _line(True, "cohort-scale",
+                 f"collective bytes N-independent ({b16}B at N=16 and "
+                 "N=64, cohort 8); cohort=N lowers byte-identical to the "
+                 "legacy full-participation program")
+
+
 def check_robust_aggregation() -> bool:
     """Each robust aggregator rejects a poisoned client on a tiny pytree.
 
@@ -767,6 +848,7 @@ def main(argv=None) -> int:
         check_program_contracts(),
         check_precision(),
         check_scan_rounds(),
+        check_cohort_scale(),
         check_observability(),
         check_serving(),
         check_serving_fleet(),

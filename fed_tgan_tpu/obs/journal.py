@@ -18,6 +18,9 @@ type                      emitted by / meaning
 run_start / run_end       journal lifecycle (run_end carries ``seconds``)
 round                     trainer round-chunk summary (first/last/seconds/...)
 aggregate                 aggregation summary for a chunk (aggregator, clients)
+cohort                    per-round partial-participation summary (population,
+                          sampled client ids, staleness histogram, buffered
+                          update counts)
 quarantine                in-round update screen quarantined a client
 client_dropped            dead/evicted client removed from federation
 watchdog_alarm            training-health watchdog tripped
@@ -68,7 +71,7 @@ SCHEMA_VERSION = 1
 
 EVENT_TYPES = frozenset({
     "run_start", "run_end",
-    "round", "aggregate",
+    "round", "aggregate", "cohort",
     "quarantine", "client_dropped",
     "watchdog_alarm", "watchdog_rollback",
     "checkpoint", "checkpoint_restore",

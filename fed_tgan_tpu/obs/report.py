@@ -65,6 +65,34 @@ def summarize(path: str) -> dict:
         if rpp:
             out["rounds"]["rounds_per_program_max"] = max(rpp)
 
+    cohorts = [e for e in events if e.get("type") == "cohort"]
+    if cohorts:
+        # one cohort event per LOGICAL round (chunk heads have
+        # round == first), so every figure here is invariant to
+        # --rounds-per-program, like the rounds section above
+        pops = [int(e["population"]) for e in cohorts
+                if isinstance(e.get("population"), int)]
+        sizes = [int(e["cohort"]) for e in cohorts
+                 if isinstance(e.get("cohort"), int)]
+        sampled: set = set()
+        for e in cohorts:
+            sampled.update(int(c) for c in e.get("clients", []) or [])
+        stale_hist: Dict[str, int] = {}
+        for e in cohorts:
+            for s_key, n in (e.get("staleness") or {}).items():
+                stale_hist[str(s_key)] = max(stale_hist.get(str(s_key), 0),
+                                             int(n))
+        applied = [int(e["buffered_applied"]) for e in cohorts
+                   if isinstance(e.get("buffered_applied"), int)]
+        out["federation_scale"] = {
+            "rounds": len(cohorts),
+            "population": max(pops) if pops else None,
+            "cohort_size": max(sizes) if sizes else None,
+            "distinct_clients_sampled": len(sampled),
+            "buffered_updates_applied": max(applied) if applied else 0,
+            "staleness_histogram": dict(sorted(stale_hist.items())),
+        }
+
     alarms = [e for e in events if e.get("type") == "watchdog_alarm"]
     rollbacks = [e for e in events if e.get("type") == "watchdog_rollback"]
     if alarms or rollbacks:
@@ -154,6 +182,16 @@ def render_text(summary: dict) -> str:
                      f"chunk(s), per-round mean {r['per_round_s_mean']}s "
                      f"max {r['per_round_s_max']}s"
                      + (f", up to {rpp} round(s)/program" if rpp else ""))
+    fs = summary.get("federation_scale")
+    if fs:
+        lines.append(f"  federation scale: population {fs['population']}, "
+                     f"cohort {fs['cohort_size']}/round over {fs['rounds']} "
+                     f"round(s), {fs['distinct_clients_sampled']} distinct "
+                     f"client(s) sampled, "
+                     f"{fs['buffered_updates_applied']} buffered update(s) "
+                     f"applied"
+                     + (f", staleness {fs['staleness_histogram']}"
+                        if fs["staleness_histogram"] else ""))
     w = summary.get("watchdog")
     if w:
         lines.append(f"  watchdog: {w['alarms']} alarm(s), "

@@ -17,18 +17,41 @@ import jax.numpy as jnp
 from fed_tgan_tpu.parallel.mesh import CLIENTS_AXIS
 
 
-def weighted_average(tree, weights: jax.Array, axis_name: str = CLIENTS_AXIS):
+def hierarchical_psum(x, axis_name: str = CLIENTS_AXIS, groups=None):
+    """Two-tier psum: intra-host reduce, then cross-host reduce.
+
+    ``groups`` is ``None`` (plain single psum — byte-identical programs to
+    pre-tier builds) or a pair ``(intra, inter)`` of
+    ``axis_index_groups`` lists: tier 1 reduces within each host's group
+    (every device holds its host's partial sum), tier 2 reduces one
+    representative column across hosts (every device ends with the global
+    sum, replicated — the same contract as a flat psum).  On multi-host
+    meshes the cross-host tier then moves one partial per host over ICI/DCN
+    instead of one per device.  See :func:`..mesh.host_axis_groups`.
+    """
+    if groups is None:
+        return jax.lax.psum(x, axis_name)
+    intra, inter = groups
+    x = jax.lax.psum(x, axis_name, axis_index_groups=intra)
+    return jax.lax.psum(x, axis_name, axis_index_groups=inter)
+
+
+def weighted_average(tree, weights: jax.Array, axis_name: str = CLIENTS_AXIS,
+                     groups=None):
     """sum_i w_i * leaf_i over the mesh axis, for every leaf.
 
     Call inside shard_map.  ``tree`` leaves carry a leading local-clients
     axis of size k (>=1); ``weights`` is the local (k,) slice of the global
     weight vector.  Returns leaves WITHOUT the leading axis: the global
-    weighted sum, identical on every device (psum replicates it).
+    weighted sum, identical on every device (psum replicates it).  The
+    intra-device ``tensordot`` over k is tier 0; ``groups`` (see
+    :func:`hierarchical_psum`) splits the cross-device reduce into
+    intra-host + cross-host tiers on multi-host meshes.
     """
 
     def avg(leaf):
         local = jnp.tensordot(weights, leaf.astype(jnp.float32), axes=1)
-        return jax.lax.psum(local, axis_name).astype(leaf.dtype)
+        return hierarchical_psum(local, axis_name, groups).astype(leaf.dtype)
 
     return jax.tree.map(avg, tree)
 
@@ -39,6 +62,8 @@ def weighted_delta_average(
     weights: jax.Array,
     axis_name: str = CLIENTS_AXIS,
     payload_dtype=jnp.bfloat16,
+    renormalize: bool = False,
+    groups=None,
 ):
     """:func:`weighted_average` with the COLLECTIVE payload re-encoded to
     ``payload_dtype`` — the bf16 half of the mixed-precision mode.
@@ -51,17 +76,33 @@ def weighted_delta_average(
 
     Requires what the fused epoch already guarantees: ``prev`` replicated
     (``leaf[0]`` is the global state) and the global ``weights`` summing
-    to 1 (so sum_i w_i * (n_i - p) == sum_i w_i * n_i - p).
+    to 1 (so sum_i w_i * (n_i - p) == sum_i w_i * n_i - p).  That second
+    precondition used to be docstring-only; ``renormalize=True`` enforces
+    it in-graph by dividing the reduced step by the global weight sum (one
+    extra scalar psum) — callers whose weights may have drifted off 1
+    after cohort masking or quarantine renormalization must pass it, so
+    the delta path cannot silently re-anchor off the true average.
+    ``renormalize=False`` keeps pre-fix programs byte-identical.
     """
 
-    def avg(p, n):
+    def avg(p, n, wsum):
         d = n.astype(jnp.float32) - p.astype(jnp.float32)
         local = jnp.tensordot(weights, d, axes=1)
-        step = jax.lax.psum(local.astype(payload_dtype), axis_name)
-        return (p[0].astype(jnp.float32)
-                + step.astype(jnp.float32)).astype(n.dtype)
+        step = hierarchical_psum(local.astype(payload_dtype), axis_name,
+                                 groups)
+        step = step.astype(jnp.float32)
+        if wsum is not None:
+            step = step / wsum
+        return (p[0].astype(jnp.float32) + step).astype(n.dtype)
 
-    return jax.tree.map(avg, prev, new)
+    wsum = None
+    if renormalize:
+        wsum = jnp.maximum(
+            hierarchical_psum(weights.astype(jnp.float32).sum(), axis_name,
+                              groups),
+            _EPS,
+        )
+    return jax.tree.map(lambda p, n: avg(p, n, wsum), prev, new)
 
 
 def replicate_local(tree, k: int):
@@ -119,6 +160,7 @@ def robust_aggregate(
     trim_ratio: float = 0.2,
     axis_name: str = CLIENTS_AXIS,
     payload_dtype=None,
+    groups=None,
 ):
     """Gate + aggregate client parameter trees inside shard_map.
 
@@ -140,6 +182,11 @@ def robust_aggregate(
     screen's ``_delta_norms``/all_gather scalars stay f32 (a poisoned
     update must not hide behind quantization), only the bulk parameter
     traffic shrinks.  ``None`` keeps the f32 programs byte-identical.
+
+    ``groups`` (see :func:`hierarchical_psum`) two-tiers the bulk psum of
+    the weighted/clipped aggregators on multi-host meshes; the gate's
+    scalar all_gathers and the gather-based trimmed/median aggregators
+    (which need every survivor's full value, not a sum) stay flat.
     """
     gather = lambda x: jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
     rank = jax.lax.axis_index(axis_name)
@@ -194,9 +241,9 @@ def robust_aggregate(
     if aggregator == "weighted":
         if payload_dtype is not None:
             agg = weighted_delta_average(
-                prev, san, w_eff_l, axis_name, payload_dtype)
+                prev, san, w_eff_l, axis_name, payload_dtype, groups=groups)
         else:
-            agg = weighted_average(san, w_eff_l, axis_name)
+            agg = weighted_average(san, w_eff_l, axis_name, groups=groups)
     elif aggregator == "clipped":
         # norm-clipped weighted mean of deltas around the global prev:
         # scale_i = min(1, update_clip * median_norm / norm_i)
@@ -211,7 +258,8 @@ def robust_aggregate(
             local = jnp.tensordot(cw_l, d, axes=1)
             if payload_dtype is not None:
                 local = local.astype(payload_dtype)
-            step = jax.lax.psum(local, axis_name).astype(jnp.float32)
+            step = hierarchical_psum(local, axis_name,
+                                     groups).astype(jnp.float32)
             return (p[0].astype(jnp.float32) + step).astype(n.dtype)
 
         agg = jax.tree.map(clip_avg, prev, san)

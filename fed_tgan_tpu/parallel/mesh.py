@@ -320,6 +320,32 @@ def client_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (CLIENTS_AXIS,))
 
 
+def host_axis_groups(mesh: Mesh):
+    """``axis_index_groups`` pair for a two-tier (intra-host, cross-host)
+    psum over the clients axis, or ``None`` when tiering buys nothing.
+
+    Tier 1 groups the mesh positions living on one host process (reduced
+    over fast intra-host interconnect); tier 2 groups one representative
+    column across hosts, so the cross-host hop moves one partial per host
+    instead of one per device.  Returns ``None`` — callers then emit the
+    plain flat psum, byte-identical to pre-tier programs — when the mesh
+    spans fewer than two processes, hosts hold unequal device counts
+    (grouped psums need rectangular groups), or each host has a single
+    device (tier 1 would be a no-op).
+    """
+    by_proc: dict[int, list[int]] = {}
+    for idx, d in enumerate(mesh.devices.flat):
+        by_proc.setdefault(d.process_index, []).append(idx)
+    groups = [by_proc[p] for p in sorted(by_proc)]
+    if len(groups) < 2:
+        return None
+    width = len(groups[0])
+    if width < 2 or any(len(g) != width for g in groups):
+        return None
+    inter = [[g[j] for g in groups] for j in range(width)]
+    return groups, inter
+
+
 def clients_per_device(n_clients: int, mesh: Mesh) -> int:
     """How many simulated participants each device hosts.
 

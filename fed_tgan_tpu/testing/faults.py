@@ -26,6 +26,13 @@ A ``FaultPlan`` describes failures to inject at exact, reproducible points:
 - ``stuck_update:rank=R[,round=E][,until=U]`` — client ``R`` replays its
   stale pre-round parameters (zero delta), the silent-failure shape the
   low-norm side of the outlier test catches.
+- ``straggle:rank=R,delay=D[,round=E][,until=U]`` — client ``R`` (1-based)
+  is a scripted straggler over rounds [E, U]: under buffered aggregation
+  (``TrainConfig.aggregation="buffered"``) it sits out each round's
+  barrier and its delta lands ``D`` rounds later, staleness-discounted;
+  under sync aggregation the fault is inert (a real straggler would
+  simply stall the barrier, which is the behavior buffered mode exists
+  to remove).
 
 The update faults are baked into the jitted epoch program at trace time;
 the trainers force chunk boundaries at the window edges so fused rounds
@@ -71,10 +78,14 @@ class FaultPlan:
     update_factor: float = 1.0  # delta scale for kind == "scale"
     update_round: int = 1       # first faulty round (1-based)
     update_until: int = 0       # last faulty round (0 = forever)
+    straggle_rank: int = 0      # 0 = no straggler fault
+    straggle_delay: int = 1     # rounds the buffered delta arrives late
+    straggle_round: int = 1     # first straggling round (1-based)
+    straggle_until: int = 0     # last straggling round (0 = forever)
 
     VALID_KINDS = ("crash_checkpoint", "delay_msg", "kill_client",
                    "nan_update", "scale_update", "sever_conn",
-                   "stuck_update")
+                   "straggle", "stuck_update")
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
@@ -106,6 +117,11 @@ class FaultPlan:
                 plan.sever_after = args["after"]
             elif name == "crash_checkpoint":
                 plan.crash_save = args.get("save", 1)
+            elif name == "straggle":
+                plan.straggle_rank = int(args["rank"])
+                plan.straggle_delay = max(1, int(args.get("delay", 1)))
+                plan.straggle_round = int(args.get("round", 1))
+                plan.straggle_until = int(args.get("until", 0))
             elif name in ("nan_update", "scale_update", "stuck_update"):
                 plan.update_kind = name.split("_", 1)[0]
                 plan.update_rank = int(args.get("rank", 1))
@@ -183,6 +199,33 @@ def update_fault_window(
     fault = ((plan.update_kind, plan.update_rank - 1, plan.update_factor)
              if active else None)
     return fault, size
+
+
+def straggle_window(
+    plan: Optional[FaultPlan], e0: int, size: int
+) -> tuple[Optional[tuple[int, int]], int]:
+    """Resolve the straggler fault for a chunk of fused rounds.
+
+    Same window contract as :func:`update_fault_window`: returns
+    ``(straggler, clipped_size)`` where ``straggler`` is
+    ``(client_idx0, delay_rounds)`` if EVERY round of the (possibly
+    clipped) chunk lies inside the straggle window, else None —
+    ``clipped_size`` lands chunk boundaries at the window edges so the
+    straggler output (a trace-time property of the fused program) never
+    flips mid-chunk.
+    """
+    if plan is None or not plan.straggle_rank:
+        return None, size
+    lo = plan.straggle_round - 1                    # 0-based first straggle
+    hi = plan.straggle_until - 1 if plan.straggle_until else None
+    for edge in sorted(x for x in (lo, (hi + 1) if hi is not None else None)
+                       if x is not None and e0 < x < e0 + size):
+        size = edge - e0
+        break
+    active = e0 >= lo and (hi is None or e0 <= hi)
+    straggler = ((plan.straggle_rank - 1, plan.straggle_delay)
+                 if active else None)
+    return straggler, size
 
 
 _active: Optional[FaultPlan] = None

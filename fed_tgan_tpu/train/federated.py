@@ -44,6 +44,7 @@ from fed_tgan_tpu.parallel.mesh import (
     CLIENTS_AXIS,
     client_mesh,
     clients_per_device,
+    host_axis_groups,
     pcast_varying,
     shard_map,
 )
@@ -137,7 +138,11 @@ def all_finite_flag(metrics) -> jnp.ndarray:
     A ``"quarantined"`` metrics entry (added by the update-validation gate)
     is not itself a loss and EXCUSES same-shaped non-finite loss entries:
     a diverged client the gate already contained must not abort training.
+    A ``"cohort"`` entry (the round's sampled client ids, integer-valued
+    bookkeeping from partial participation) is excluded entirely.
     """
+    if isinstance(metrics, dict) and "cohort" in metrics:
+        metrics = {n: m for n, m in metrics.items() if n != "cohort"}
     if isinstance(metrics, dict) and "quarantined" in metrics:
         q = metrics["quarantined"] > 0
         finite = jnp.stack([
@@ -154,7 +159,7 @@ def all_finite_flag(metrics) -> jnp.ndarray:
 
 def make_federated_epoch(
     spec: SegmentSpec, cfg: TrainConfig, max_steps: int, mesh, k: int,
-    rounds: int = 1, update_fault=None,
+    rounds: int = 1, update_fault=None, psum_groups=None, straggle=None,
 ):
     """Build the jitted SPMD program for ``rounds`` federated rounds.
 
@@ -173,6 +178,30 @@ def make_federated_epoch(
     this program — a trace-time constant, so the callers force chunk
     boundaries at the fault window's edges.
 
+    ``cfg.cohort`` (0 < C < N) decouples the resident population N from the
+    per-round participants: every round each device draws a key-derived,
+    bit-reproducible sample of kc = C / n_devices of its k residents,
+    gathers their fixed-shape slices (models, shard rows, sampler tables,
+    step budgets), renormalizes the similarity weights over the cohort
+    (one scalar psum), trains and aggregates ONLY those slices, then
+    scatters the trained optimizer/discriminator state back.  Round
+    compute, memory traffic, and collective payload are O(C) + O(model) —
+    independent of N.  The sampling machinery only traces when it is
+    active, so C=0 and C=N programs stay byte-identical to pre-cohort
+    builds; metrics then gain an integer ``"cohort"`` entry naming the
+    sampled global client ids per round.
+
+    ``psum_groups`` (:func:`..parallel.mesh.host_axis_groups`) two-tiers
+    the aggregation psums on multi-host meshes; ``None`` (single host)
+    keeps programs byte-identical.
+
+    ``straggle`` (a global client index, or None) supports the buffered
+    aggregation mode: the named client's weighted delta is ALSO returned
+    as a separate replicated per-round output (zero if the client is not
+    sampled), so the host can exclude the straggler from the barrier
+    (weight masked to 0) and land its update, staleness-discounted, in a
+    later round.
+
     Arguments of the returned function (all with leading n_clients axis,
     sharded over 'clients', except ``key`` which is replicated):
     models, data, cond, rows, steps, weights, key.
@@ -189,11 +218,25 @@ def make_federated_epoch(
     """
     step = make_train_step(spec, cfg)
 
-    def one_round(models, data, cond, rows, steps_i, key):
-        # local blocks carry leading axis k (participants on this device)
+    n_dev = mesh.devices.size
+    cohort = getattr(cfg, "cohort", 0) or 0
+    use_cohort = 0 < cohort < k * n_dev
+    if use_cohort and cohort % n_dev != 0:
+        raise ValueError(
+            f"cohort={cohort} must be a multiple of mesh size {n_dev} so "
+            "every device contributes the same number of participants"
+        )
+    kc = cohort // n_dev if use_cohort else k
+
+    def one_round(models, data, cond, rows, steps_i, key, local_ids):
+        # local blocks carry a leading participants axis (k residents under
+        # full participation, the kc sampled cohort members otherwise)
         rank = jax.lax.axis_index(CLIENTS_AXIS)
 
         def run_one(models_i, data_i, cond_i, rows_i, steps_ii, local_idx):
+            # folded on the client's GLOBAL identity: a sampled client
+            # advances the same per-client stream it would under full
+            # participation
             key_i = jax.random.fold_in(key, rank * k + local_idx)
             # mark the zero init as device-varying so the scan carry type
             # matches the per-client metrics produced inside the loop
@@ -218,7 +261,7 @@ def make_federated_epoch(
             )
             return models_i, metrics
 
-        return jax.vmap(run_one)(models, data, cond, rows, steps_i, jnp.arange(k))
+        return jax.vmap(run_one)(models, data, cond, rows, steps_i, local_ids)
 
     use_ema = cfg.ema_decay > 0.0
     # the legacy single-psum path compiles only when nothing robust can
@@ -233,20 +276,20 @@ def make_federated_epoch(
     payload_dtype = (jnp.bfloat16 if cfg.precision == "bf16" else None)
 
     def epoch_local(models, data, cond, rows, steps_i, weight, key, *ema_in):
-        avg = partial(weighted_average, weights=weight)
 
-        def corrupt_updates(prev_trees, new_trees):
+        def corrupt_updates(prev_trees, new_trees, local_ids):
             """Apply the injected update fault to the faulty client's slice
             (post-training, pre-aggregation — exactly where a hostile or
             diverged client corrupts the protocol)."""
             kind, fidx, factor = update_fault
             rank = jax.lax.axis_index(CLIENTS_AXIS)
-            mask = (rank * k + jnp.arange(k)) == fidx  # (k,) local clients
+            mask = (rank * k + local_ids) == fidx  # local participants
+            kdim = local_ids.shape[0]
 
             def corrupt(p, n):
                 if not jnp.issubdtype(n.dtype, jnp.floating):
                     return n
-                m = mask.reshape((k,) + (1,) * (n.ndim - 1))
+                m = mask.reshape((kdim,) + (1,) * (n.ndim - 1))
                 if kind == "nan":
                     bad = jnp.full_like(n, jnp.nan)
                 elif kind == "scale":
@@ -257,43 +300,106 @@ def make_federated_epoch(
 
             return jax.tree.map(corrupt, prev_trees, new_trees)
 
+        def straggler_delta(prev_trees, new_trees, local_ids):
+            """The straggler's weighted-delta payload, replicated (no
+            leading participants axis); zero when it isn't sampled."""
+            rank = jax.lax.axis_index(CLIENTS_AXIS)
+            mask = (rank * k + local_ids) == straggle
+            kdim = local_ids.shape[0]
+
+            def one(p, n):
+                if not jnp.issubdtype(n.dtype, jnp.floating):
+                    return jnp.zeros(n.shape[1:], jnp.float32)
+                m = mask.reshape((kdim,) + (1,) * (n.ndim - 1))
+                d = jnp.where(
+                    m, n.astype(jnp.float32) - p.astype(jnp.float32), 0.0)
+                return jax.lax.psum(d.sum(axis=0), CLIENTS_AXIS)
+
+            return jax.tree.map(one, prev_trees, new_trees)
+
         def round_body(carry, _):
             models_c, chain, ema_c = carry
-            # pre-round state is replicated across the k axis (every slice
-            # holds the global model), which robust_aggregate relies on
-            prev_agg = (models_c.params_g, models_c.params_d,
-                        models_c.state_g)
             # same split protocol the host loop used, now on device
             chain, rkey = jax.random.split(chain)
-            models_c, metrics = one_round(models_c, data, cond, rows, steps_i, rkey)
+            if use_cohort:
+                # key-derived, bit-reproducible cohort draw: every device
+                # samples kc of its k residents (stratified, so the round
+                # keeps one SPMD shape).  Non-members neither train nor
+                # enter any collective this round.
+                rank = jax.lax.axis_index(CLIENTS_AXIS)
+                sel_key, rkey = jax.random.split(rkey)
+                local_ids = jax.random.permutation(
+                    jax.random.fold_in(sel_key, rank), k)[:kc]
+                take = lambda t: jax.tree.map(
+                    lambda x: jnp.take(x, local_ids, axis=0), t)
+                models_s = take(models_c)
+                data_s, cond_s, rows_s = take(data), take(cond), take(rows)
+                steps_s = jnp.take(steps_i, local_ids, axis=0)
+                w_s = jnp.take(weight, local_ids, axis=0)
+                # similarity weights renormalized over the sampled cohort —
+                # ONE scalar psum, O(1) in both population and cohort size
+                w_s = w_s / jnp.maximum(
+                    jax.lax.psum(w_s.sum(), CLIENTS_AXIS), 1e-12)
+            else:
+                models_s = models_c
+                data_s, cond_s, rows_s = data, cond, rows
+                steps_s, w_s = steps_i, weight
+                local_ids = jnp.arange(k)
+            # pre-round state is replicated across the participants axis
+            # (every slice holds the global model), which robust_aggregate
+            # and the cohort gather both rely on
+            prev_agg = (models_s.params_g, models_s.params_d,
+                        models_s.state_g)
+            models_s, metrics = one_round(
+                models_s, data_s, cond_s, rows_s, steps_s, rkey, local_ids)
             # ---- the entire Fed-TGAN communication round: one weighted psum
-            new_agg = (models_c.params_g, models_c.params_d,
-                       models_c.state_g)
+            new_agg = (models_s.params_g, models_s.params_d,
+                       models_s.state_g)
             if update_fault is not None:
-                new_agg = corrupt_updates(prev_agg, new_agg)
+                new_agg = corrupt_updates(prev_agg, new_agg, local_ids)
+            sdelta = (straggler_delta(prev_agg, new_agg, local_ids)
+                      if straggle is not None else None)
             if use_robust:
                 (avg_g, avg_d, avg_sg), quar = robust_aggregate(
-                    prev_agg, new_agg, weight, steps_i, k,
+                    prev_agg, new_agg, w_s, steps_s, kc,
                     aggregator=cfg.aggregator,
                     update_gate=cfg.update_gate,
                     gate_norm_factor=cfg.gate_norm_factor,
                     update_clip=cfg.update_clip,
                     trim_ratio=cfg.trim_ratio,
                     payload_dtype=payload_dtype,
+                    groups=psum_groups,
                 )
                 metrics = dict(metrics)
                 metrics["quarantined"] = quar
             elif payload_dtype is not None:
-                davg = partial(weighted_delta_average, weights=weight,
-                               payload_dtype=payload_dtype)
+                davg = partial(weighted_delta_average, weights=w_s,
+                               payload_dtype=payload_dtype,
+                               groups=psum_groups)
                 prev_g, prev_d, prev_sg = prev_agg
                 new_g, new_d, new_sg = new_agg
                 avg_g, avg_d, avg_sg = (
                     davg(prev_g, new_g), davg(prev_d, new_d),
                     davg(prev_sg, new_sg))
             else:
+                avg = partial(weighted_average, weights=w_s,
+                              groups=psum_groups)
                 new_g, new_d, new_sg = new_agg
                 avg_g, avg_d, avg_sg = avg(new_g), avg(new_d), avg(new_sg)
+            if use_cohort:
+                # scatter the cohort's trained local state (optimizer
+                # moments, D state, per-client schedules) back into the
+                # resident stacks; non-members keep theirs.  Params are
+                # then overwritten below with the replicated aggregate for
+                # EVERYONE, exactly as under full participation.
+                models_c = jax.tree.map(
+                    lambda full, new_: full.at[local_ids].set(new_),
+                    models_c, models_s)
+                metrics = dict(metrics)
+                rank = jax.lax.axis_index(CLIENTS_AXIS)
+                metrics["cohort"] = (rank * k + local_ids).astype(jnp.int32)
+            else:
+                models_c = models_s
             models_c = models_c._replace(
                 params_g=replicate_local(avg_g, k),
                 params_d=replicate_local(avg_d, k),
@@ -308,13 +414,20 @@ def make_federated_epoch(
                     lambda e_, n: d * e_ + (1.0 - d) * n,
                     ema_c, (avg_g, avg_sg),
                 )
-            return (models_c, chain, ema_c), metrics
+            ys = metrics if straggle is None else (metrics, sdelta)
+            return (models_c, chain, ema_c), ys
 
         ema = ema_in[0] if use_ema else ()
-        (models, key, ema), metrics = jax.lax.scan(
+        (models, key, ema), ys = jax.lax.scan(
             round_body, (models, key, ema), None, length=rounds
         )
+        if straggle is None:
+            metrics, sdelta = ys, None
+        else:
+            metrics, sdelta = ys
         out = (models, metrics, key, all_finite_flag(metrics))
+        if sdelta is not None:
+            out = out + (sdelta,)
         return out + (ema,) if use_ema else out
 
     sharded = P(CLIENTS_AXIS)
@@ -322,6 +435,8 @@ def make_federated_epoch(
     # metrics carry a leading rounds axis; the key chain and the finite
     # flag are replicated
     out_specs = [sharded, P(None, CLIENTS_AXIS), P(), P()]
+    if straggle is not None:
+        out_specs.append(P())  # straggler delta: replicated, rounds-leading
     if use_ema:
         in_specs.append(P())   # EMA rides replicated, like the key chain
         out_specs.append(P())
@@ -421,7 +536,7 @@ class RoundBookkeeping:
         # one loss first, and that round is what a resume should predate
         bad = None
         for name, leaf in metrics.items():
-            if name == "quarantined":
+            if name in ("quarantined", "cohort"):
                 continue
             arr = np.asarray(leaf)
             fin = np.isfinite(arr)
@@ -515,6 +630,28 @@ class FederatedTrainer(RoundBookkeeping):
                 )
         self.mesh = mesh
         self.k = clients_per_device(n_clients, self.mesh)
+        if self.cfg.aggregation not in ("sync", "buffered"):
+            raise ValueError(
+                f"aggregation={self.cfg.aggregation!r}: expected sync|buffered"
+            )
+        n_dev = self.mesh.devices.size
+        if self.cfg.cohort:
+            if not 0 < self.cfg.cohort <= n_clients:
+                raise ValueError(
+                    f"cohort={self.cfg.cohort} must be in 1..{n_clients} "
+                    "(the resident client population)"
+                )
+            if self.cfg.cohort % n_dev != 0:
+                raise ValueError(
+                    f"cohort={self.cfg.cohort} must be a multiple of the "
+                    f"mesh size {n_dev} (SPMD round shape)"
+                )
+        # two-tier psum groups on multi-host meshes; None (single host)
+        # keeps every aggregation program byte-identical
+        self._psum_groups = host_axis_groups(self.mesh)
+        # buffered-mode straggler deltas awaiting their arrival round
+        self._buffered: list[dict] = []
+        self._buffered_applied = 0
 
         self.spec = SegmentSpec.from_output_info(init.output_info)
 
@@ -522,6 +659,16 @@ class FederatedTrainer(RoundBookkeeping):
          self.server_cond) = build_client_stacks(init, self.cfg, self.spec)
         self.max_steps = int(self.steps.max())
         self.weights = np.asarray(init.weights, dtype=np.float32)
+        if (self.cfg.precision == "bf16"
+                and not np.isclose(self.weights.sum(), 1.0, atol=1e-4)):
+            # the bf16 delta path re-anchors on prev and assumes
+            # sum(w) == 1 (parallel/fedavg.py::weighted_delta_average);
+            # fail fast instead of silently drifting off-anchor
+            raise ValueError(
+                f"similarity weights sum to {self.weights.sum():.6f}, not 1: "
+                "the bf16 delta-encoded aggregation requires normalized "
+                "weights (renormalize init.weights first)"
+            )
 
         # identical initial models on every client (the reference seeds all
         # clients alike and the server adopts client 0's, distributed.py:789)
@@ -577,12 +724,16 @@ class FederatedTrainer(RoundBookkeeping):
         spec = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         return jax.device_put(tree, spec)
 
-    def _epoch_fn_for(self, rounds: int, update_fault=None):
-        key = (rounds, update_fault)
+    def _epoch_fn_for(self, rounds: int, update_fault=None, straggle=None):
+        # 2-tuple keys while no straggler is scripted, so pre-buffered
+        # callers (and tests) see the exact historical cache shape
+        key = ((rounds, update_fault) if straggle is None
+               else (rounds, update_fault, straggle))
         if key not in self._epoch_fns:
             self._epoch_fns[key] = make_federated_epoch(
                 self.spec, self.cfg, self.max_steps, self.mesh, self.k,
                 rounds=rounds, update_fault=update_fault,
+                psum_groups=self._psum_groups, straggle=straggle,
             )
         return self._epoch_fns[key]
 
@@ -638,6 +789,59 @@ class FederatedTrainer(RoundBookkeeping):
         if plan is None or not plan.kill_rank:
             return None
         return plan
+
+    def _apply_buffered(self, models, e: int):
+        """Fold every buffered straggler delta whose arrival round is due
+        into the replicated global params, discounted by
+        ``staleness_discount ** staleness`` (buffered aggregation mode).
+
+        Composes with the Byzantine machinery: a non-finite buffered delta
+        is contained like an in-round quarantine (a strike, never applied).
+        Buffered state is host-side only — a watchdog rollback rebuilds the
+        trainer and clears the queue, which is the safe direction (a stale
+        delta from a rolled-back timeline must not land).
+        """
+        due = [u for u in self._buffered if u["arrival"] <= e]
+        if not due:
+            return models
+        self._buffered = [u for u in self._buffered if u["arrival"] > e]
+        for upd in due:
+            idx = int(upd["client"])
+            if idx in self.dropped_clients:
+                continue
+            if not all(
+                np.isfinite(np.asarray(leaf)).all()
+                for part in upd["delta"] for leaf in jax.tree.leaves(part)
+            ):
+                self._strikes[idx] += 1
+                _QUARANTINED_TOTAL.inc()
+                _emit_event("quarantine", client=idx, rounds=1, first=e,
+                            last=e, strikes=int(self._strikes[idx]),
+                            buffered=True)
+                continue
+            eff = float(upd["weight"]) * (
+                self.cfg.staleness_discount ** upd["staleness"])
+
+            def mix(m, d):
+                if not jnp.issubdtype(jnp.asarray(m).dtype, jnp.floating):
+                    return m
+                return (jnp.asarray(m, jnp.float32)
+                        + eff * jnp.asarray(d)[None]).astype(m.dtype)
+
+            dg, dd, dsg = upd["delta"]
+            models = models._replace(
+                params_g=jax.tree.map(mix, models.params_g, dg),
+                params_d=jax.tree.map(mix, models.params_d, dd),
+                state_g=jax.tree.map(mix, models.state_g, dsg),
+            )
+            self._buffered_applied += 1
+            _emit_event("aggregate", round=e, first=e, rounds_per_program=1,
+                        aggregator="buffered", clients=1, client=idx,
+                        origin=int(upd["origin"]),
+                        staleness=int(upd["staleness"]),
+                        discount=round(eff, 8))
+        self.models = models
+        return models
 
     def fit(self, epochs: int, log_every: int = 0, sample_hook=None,
             hook_epochs=None, max_rounds_per_call: int = 16,
@@ -717,6 +921,33 @@ class FederatedTrainer(RoundBookkeeping):
             # the update fault is a trace-time constant of the fused
             # program, so the chunk is clipped to the fault window's edges
             update_fault, size = update_fault_window(active_plan(), e, size)
+            straggle_idx, straggle_delay = None, 0
+            if self.cfg.aggregation == "buffered":
+                from fed_tgan_tpu.testing.faults import straggle_window
+
+                sspec, size = straggle_window(active_plan(), e, size)
+                if sspec is not None:
+                    # one round per program while the straggler is
+                    # scripted: each round's delta is pulled and buffered
+                    straggle_idx, straggle_delay = sspec
+                    size = 1
+            if self._buffered:
+                models = self._apply_buffered(models, e)
+            if self._buffered:
+                # chunk boundary at the earliest pending arrival so the
+                # buffered delta lands exactly at its arrival round
+                size = min(size, max(
+                    1, min(u["arrival"] for u in self._buffered) - e))
+            weights_call = weights
+            if straggle_idx is not None:
+                # the straggler leaves this round's barrier: its weight is
+                # masked to 0 and survivors renormalized — an ad-hoc upload,
+                # self.weights and the resident stacks stay untouched
+                alive = np.ones(self.n_clients, dtype=bool)
+                alive[list(self.dropped_clients)] = False
+                alive[straggle_idx] = False
+                weights_call = self._shard(
+                    jnp.asarray(renormalize_weights(self.weights, alive)))
             # last-good, for a failed sync
             prev = (self.models, self._key, self.ema, self._ema_updates)
             t0 = time.time()
@@ -724,27 +955,24 @@ class FederatedTrainer(RoundBookkeeping):
             # --sanitize any implicit device->host pull in here raises
             # (first entry per region compiles and stays unguarded)
             region = f"train.federated.epoch[r{size}" \
-                     f"{'+fault' if update_fault else ''}]"
+                     f"{'+fault' if update_fault else ''}" \
+                     f"{'+straggle' if straggle_idx is not None else ''}]"
             # the span is host-side timing only (no device sync), so it
             # wraps the hot region without perturbing the transfer guard
+            args = [models, data, cond, rows, steps, weights_call, self._key]
             if use_ema:
-                with _span("train.local_steps", rounds=size,
-                           rounds_per_program=size), \
-                        hot_region(region):
-                    (models, metrics, self._key, finite,
-                     self.ema) = self._epoch_fn_for(size, update_fault)(
-                        models, data, cond, rows, steps, weights, self._key,
-                        self.ema,
-                    )
+                args.append(self.ema)
+            with _span("train.local_steps", rounds=size,
+                       rounds_per_program=size), \
+                    hot_region(region):
+                outs = self._epoch_fn_for(
+                    size, update_fault, straggle_idx)(*args)
+            models, metrics, self._key, finite = outs[:4]
+            rest = list(outs[4:])
+            sdelta = rest.pop(0) if straggle_idx is not None else None
+            if use_ema:
+                self.ema = rest.pop(0)
                 self._ema_updates += size
-            else:
-                with _span("train.local_steps", rounds=size,
-                           rounds_per_program=size), \
-                        hot_region(region):
-                    (models, metrics, self._key,
-                     finite) = self._epoch_fn_for(size, update_fault)(
-                        models, data, cond, rows, steps, weights, self._key
-                    )
             # divergence check: ONE scalar crosses to host (fetching it also
             # serves as the chunk's sync point); the full metric arrays are
             # pulled only on the failure path to name the bad round.  State
@@ -786,6 +1014,19 @@ class FederatedTrainer(RoundBookkeeping):
             with _span("train.aggregate.sync", rounds=size):
                 self._sync_or_rollback(finite, _rollback, sample_hook)
             ok = on_nonfinite == "ignore" or bool(finite)
+            if sdelta is not None:
+                # size == 1 here: queue the straggler's delta for its
+                # arrival round (it sat out this round's barrier)
+                d_host = jax.tree.map(
+                    lambda x: np.asarray(x)[0], jax.device_get(sdelta))
+                self._buffered.append({
+                    "client": int(straggle_idx),
+                    "origin": e,
+                    "arrival": e + max(1, int(straggle_delay)),
+                    "staleness": max(1, int(straggle_delay)),
+                    "weight": float(self.weights[straggle_idx]),
+                    "delta": d_host,
+                })
             # every consumer of metric VALUES below (divergence naming,
             # quarantine counts, health watchdog, log means) reads this
             # ONE explicit batched transfer — a single host round trip
@@ -796,7 +1037,8 @@ class FederatedTrainer(RoundBookkeeping):
                 not ok
                 or health_cb is not None
                 or log_due
-                or (isinstance(metrics, dict) and "quarantined" in metrics)
+                or (isinstance(metrics, dict)
+                    and ("quarantined" in metrics or "cohort" in metrics))
             )
             with _span("train.monitor", pulled=bool(need_host)):
                 metrics_host = jax.device_get(metrics) if need_host else None
@@ -804,9 +1046,17 @@ class FederatedTrainer(RoundBookkeeping):
                 self._check_finite(metrics_host, e, on_nonfinite)
             if isinstance(metrics_host, dict) and \
                     "quarantined" in metrics_host:
-                q = np.asarray(metrics_host["quarantined"]) > 0.5  # (size, n)
+                q = np.asarray(metrics_host["quarantined"]) > 0.5  # (size, C)
                 if q.any():
-                    counts = q.sum(axis=0).astype(np.int64)
+                    if "cohort" in metrics_host:
+                        # partial participation: column j is the round's
+                        # j-th SAMPLED participant, so strikes are charged
+                        # through the sampled global ids
+                        ids = np.asarray(metrics_host["cohort"])
+                        counts = np.zeros(self.n_clients, dtype=np.int64)
+                        np.add.at(counts, ids[q].ravel(), 1)
+                    else:
+                        counts = q.sum(axis=0).astype(np.int64)
                     self._strikes += counts
                     _QUARANTINED_TOTAL.inc(int(counts.sum()))
                     import logging
@@ -870,6 +1120,33 @@ class FederatedTrainer(RoundBookkeeping):
                             rounds_per_program=size,
                             aggregator=self.cfg.aggregator,
                             clients=n_live)
+            # federation-scale observability: one cohort event per LOGICAL
+            # round (chunk-head convention like round/aggregate above, so
+            # `obs report` stays K-invariant) naming the sampled ids, the
+            # pending-staleness histogram, and the buffered-apply counter
+            cohort_ids = (np.asarray(metrics_host["cohort"])
+                          if isinstance(metrics_host, dict)
+                          and "cohort" in metrics_host else None)
+            if cohort_ids is not None or self.cfg.aggregation == "buffered":
+                stale_hist: dict[str, int] = {}
+                for u in self._buffered:
+                    s_key = str(u["staleness"])
+                    stale_hist[s_key] = stale_hist.get(s_key, 0) + 1
+                for ei in range(e, e + size):
+                    row = (cohort_ids[ei - e]
+                           if cohort_ids is not None else None)
+                    _emit_event(
+                        "cohort", round=ei, first=e,
+                        rounds_per_program=size,
+                        population=self.n_clients,
+                        cohort=(int(row.size) if row is not None
+                                else n_live),
+                        clients=(sorted(int(x) for x in row)
+                                 if row is not None else []),
+                        buffered_pending=len(self._buffered),
+                        buffered_applied=self._buffered_applied,
+                        staleness=stale_hist,
+                    )
             if log_due:
                 m = jax.tree.map(lambda x: np.asarray(x).mean(),
                                  metrics_host)

@@ -37,6 +37,7 @@ from fed_tgan_tpu.obs.journal import emit as _emit_event
 from fed_tgan_tpu.obs.registry import counter as _metric_counter
 from fed_tgan_tpu.obs.trace import span as _span
 from fed_tgan_tpu.ops.segments import SegmentSpec
+from fed_tgan_tpu.parallel.mesh import host_axis_groups
 from fed_tgan_tpu.parallel.multihost import (
     from_local_chunk,
     local_shard,
@@ -410,9 +411,14 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
             update_fault, size = update_fault_window(active_plan(), e, size)
             fn_key = (size, update_fault)
             if fn_key not in epoch_fns:
+                # two-tier aggregation on real multi-host meshes: intra-host
+                # grouped psum then a cross-host column reduce (None — the
+                # byte-identical flat psum — when the mesh is single-host
+                # or one-device-per-host, as in the socket harness)
                 epoch_fns[fn_key] = make_federated_epoch(
                     spec, cfg, max_steps, mesh, k=1, rounds=size,
                     update_fault=update_fault,
+                    psum_groups=host_axis_groups(mesh),
                 )
             t0 = time.time()
             if use_ema:

@@ -96,6 +96,22 @@ class TrainConfig:
     # being the default — never enters config_signature, so existing
     # checkpoints stay valid by construction.
     precision: str = "f32"           # f32 | bf16
+    # Cohort-sampled partial participation: per-round number of clients
+    # that actually train and aggregate.  0 = full participation (every
+    # resident client, the reference protocol; byte-identical programs to
+    # pre-cohort builds — the sampling machinery only traces when
+    # 0 < cohort < population).  When set, each round draws a key-derived,
+    # bit-reproducible cohort on device; round compute and collective
+    # payload become O(cohort) + O(model), independent of the population.
+    cohort: int = 0
+    # Aggregation barrier mode.  "sync" is the classic lockstep round.
+    # "buffered" lets scripted stragglers (testing/faults.py "straggle")
+    # ship their delta out-of-band: it lands `delay` rounds later,
+    # discounted by staleness_discount**staleness, instead of stalling the
+    # barrier.  With no straggler active, "buffered" is bit-identical to
+    # "sync".
+    aggregation: str = "sync"        # sync | buffered
+    staleness_discount: float = 0.5  # per-round decay of buffered deltas
 
 
 def lr_decay_horizon(lr_schedule: str, epochs: int, max_shard_rows: int,

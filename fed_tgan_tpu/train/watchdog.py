@@ -73,12 +73,14 @@ class TrainingWatchdog:
 
         ``metrics`` maps name -> (rounds, n_clients) arrays; a
         ``"quarantined"`` entry excuses same-shaped non-finite/huge losses
-        (the gate already contained that client)."""
+        (the gate already contained that client).  A ``"cohort"`` entry
+        (sampled client ids under partial participation — integers that can
+        legitimately dwarf loss_threshold) is bookkeeping, not health."""
         q = None
         if "quarantined" in metrics:
             q = np.asarray(metrics["quarantined"]) > 0
         for name, leaf in metrics.items():
-            if name == "quarantined":
+            if name in ("quarantined", "cohort"):
                 continue
             arr = np.asarray(leaf)
             bad = ~np.isfinite(arr) | (np.abs(arr) > self.cfg.loss_threshold)

@@ -1,0 +1,243 @@
+"""Cohort-sampled partial participation (``--cohort C``) and buffered
+straggler aggregation (``--aggregation buffered``) on the 8-virtual-device
+CPU mesh: C=N must stay bit-identical to the legacy full-participation
+program, cohort draws must be deterministic across checkpoint/resume, a
+scripted straggler's delta must land staleness-discounted in a later
+round, and the Byzantine gate must keep charging strikes to the right
+client on exactly the rounds it was sampled."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.parallel.mesh import client_mesh
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+
+pytestmark = pytest.mark.cohort
+
+#: 16 clients packed 2-per-device on the 8-device mesh; batch 20 keeps
+#: one local step per ~37-row shard.
+CFG = TrainConfig(embedding_dim=8, gen_dims=(16,), dis_dims=(16,),
+                  batch_size=20, pac=4)
+N_CLIENTS = 16
+COHORT = 8
+
+
+@pytest.fixture(scope="module")
+def fed_init16(toy_frame, toy_spec):
+    shards = shard_dataframe(toy_frame, N_CLIENTS, "iid", seed=9)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    return federated_initialize(clients, seed=0)
+
+
+def _fit_collecting(trainer, epochs, **fit_kw):
+    """fit() with a health_cb stacking the per-chunk metric arrays;
+    returns {name: (rounds, ...) array} concatenated over chunks."""
+    chunks = []
+
+    def cb(first_round, metrics):
+        chunks.append({n: np.asarray(m) for n, m in metrics.items()})
+
+    trainer.fit(epochs, health_cb=cb, **fit_kw)
+    names = set().union(*(c.keys() for c in chunks)) if chunks else set()
+    return {n: np.concatenate([c[n] for c in chunks if n in c], axis=0)
+            for n in names}
+
+
+def _assert_models_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a.models), jax.tree.leaves(b.models)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_cohort_equal_population_bit_identical(fed_init16):
+    """C=N (and C=0) is full participation: params, key chain, and strikes
+    must be bit-identical to the pre-cohort program, and no cohort
+    bookkeeping may leak into the metrics."""
+    mesh = client_mesh(8)
+    legacy = FederatedTrainer(fed_init16, config=CFG, mesh=mesh, seed=11)
+    full = FederatedTrainer(
+        fed_init16, config=dataclasses.replace(CFG, cohort=N_CLIENTS),
+        mesh=mesh, seed=11)
+
+    m_legacy = _fit_collecting(legacy, 3)
+    m_full = _fit_collecting(full, 3)
+
+    assert "cohort" not in m_legacy and "cohort" not in m_full
+    _assert_models_equal(legacy, full)
+    np.testing.assert_array_equal(
+        jax.random.key_data(legacy._key), jax.random.key_data(full._key))
+    np.testing.assert_array_equal(legacy._strikes, full._strikes)
+    assert legacy.completed_epochs == full.completed_epochs == 3
+
+
+def test_cohort_sampling_shape_and_stratification(fed_init16):
+    """C=8 of 16: every round reports 8 distinct global client ids, one
+    per device (stratified draw), and the draw varies across rounds."""
+    mesh = client_mesh(8)
+    tr = FederatedTrainer(
+        fed_init16, config=dataclasses.replace(CFG, cohort=COHORT),
+        mesh=mesh, seed=11)
+    m = _fit_collecting(tr, 4)
+
+    ids = m["cohort"]
+    assert ids.shape == (4, COHORT)
+    assert ids.min() >= 0 and ids.max() < N_CLIENTS
+    k = N_CLIENTS // 8
+    for r in range(ids.shape[0]):
+        row = ids[r]
+        assert len(set(row.tolist())) == COHORT
+        # one participant per device: the device of id i is i // k
+        assert sorted(set((row // k).tolist())) == list(range(8))
+    # the selection key chains per round: draws must not be frozen
+    assert any(not np.array_equal(ids[0], ids[r])
+               for r in range(1, ids.shape[0]))
+
+
+def test_cohort_deterministic_across_resume(fed_init16, tmp_path):
+    """2 rounds + checkpoint + 2 rounds must sample the SAME cohorts and
+    land the SAME params as 4 uninterrupted rounds: the selection key
+    rides the checkpointed PRNG chain."""
+    from fed_tgan_tpu.runtime.checkpoint import load_federated, save_federated
+
+    cfg = dataclasses.replace(CFG, cohort=COHORT)
+    mesh = client_mesh(8)
+    straight = FederatedTrainer(fed_init16, config=cfg, mesh=mesh, seed=7)
+    m_straight = _fit_collecting(straight, 4)
+
+    interrupted = FederatedTrainer(fed_init16, config=cfg, mesh=mesh, seed=7)
+    m_a = _fit_collecting(interrupted, 2)
+    save_federated(interrupted, str(tmp_path / "ckpt"), run_name="toy")
+    resumed = load_federated(str(tmp_path / "ckpt"), mesh=mesh)
+    assert resumed.cfg.cohort == COHORT  # knob survives the round trip
+    m_b = _fit_collecting(resumed, 2)
+
+    np.testing.assert_array_equal(
+        m_straight["cohort"],
+        np.concatenate([m_a["cohort"], m_b["cohort"]], axis=0))
+    _assert_models_equal(straight, resumed)
+    np.testing.assert_array_equal(
+        jax.random.key_data(straight._key), jax.random.key_data(resumed._key))
+
+
+def test_buffered_without_straggler_is_sync(fed_init16):
+    """aggregation="buffered" with no straggle fault active must be
+    bit-identical to sync: the buffer machinery only engages on faults."""
+    mesh = client_mesh(8)
+    sync = FederatedTrainer(fed_init16, config=CFG, mesh=mesh, seed=3)
+    buf = FederatedTrainer(
+        fed_init16, config=dataclasses.replace(CFG, aggregation="buffered"),
+        mesh=mesh, seed=3)
+    sync.fit(3)
+    buf.fit(3)
+    _assert_models_equal(sync, buf)
+    np.testing.assert_array_equal(
+        jax.random.key_data(sync._key), jax.random.key_data(buf._key))
+    assert buf._buffered_applied == 0 and buf._buffered == []
+
+
+def test_buffered_straggler_staleness_accounting(fed_init16, tmp_path):
+    """A scripted straggler (rounds 2-3, delay 2) under buffered
+    aggregation: its delta is withheld from those rounds' barriers and
+    re-applied ``delay`` rounds later with the staleness discount, and the
+    journal records the arrivals."""
+    from fed_tgan_tpu.obs.journal import RunJournal, read_journal, set_journal
+    from fed_tgan_tpu.obs.report import summarize
+    from fed_tgan_tpu.testing.faults import FaultPlan, install_plan
+
+    cfg = dataclasses.replace(CFG, aggregation="buffered")
+    mesh = client_mesh(8)
+    path = str(tmp_path / "straggle.jsonl")
+    install_plan(FaultPlan.parse("straggle:rank=3,delay=2,round=2,until=3"))
+    try:
+        tr = FederatedTrainer(fed_init16, config=cfg, mesh=mesh, seed=5)
+        with RunJournal(path, run_id="straggle") as j:
+            set_journal(j)
+            try:
+                tr.fit(6)
+            finally:
+                set_journal(None)
+    finally:
+        install_plan(None)
+
+    # rounds 1 and 2 (0-based) straggle; arrivals at 3 and 4 both land
+    assert tr._buffered_applied == 2
+    assert tr._buffered == []
+    events = [e for e in read_journal(path)
+              if e.get("type") == "aggregate"
+              and e.get("aggregator") == "buffered"]
+    assert [(e["origin"], e["round"], e["staleness"]) for e in events] \
+        == [(1, 3, 2), (2, 4, 2)]
+    assert all(e["client"] == 2 for e in events)  # rank=3 -> 0-based 2
+    # discount = weight * 0.5^2, strictly positive and below the weight
+    w = float(tr.weights[2])
+    for e in events:
+        assert 0 < e["discount"] < w
+    fs = summarize(path)["federation_scale"]
+    assert fs["buffered_updates_applied"] == 2
+    assert fs["population"] == N_CLIENTS
+
+
+def test_federation_scale_report_invariant_to_fusion(fed_init16, tmp_path):
+    """One ``cohort`` journal event per LOGICAL round: the `obs report`
+    federation-scale section must agree between a K=4 fused run and 4
+    sequential dispatches — same sampled cohorts, same figures."""
+    from fed_tgan_tpu.obs.journal import RunJournal, read_journal, set_journal
+    from fed_tgan_tpu.obs.report import summarize
+
+    cfg = dataclasses.replace(CFG, cohort=COHORT)
+    mesh = client_mesh(8)
+    sums, clients = {}, {}
+    for label, k in (("fused", 4), ("seq", 1)):
+        path = str(tmp_path / f"{label}.jsonl")
+        tr = FederatedTrainer(fed_init16, config=cfg, mesh=mesh, seed=2)
+        with RunJournal(path, run_id=label) as j:
+            set_journal(j)
+            try:
+                tr.fit(4, max_rounds_per_call=k)
+            finally:
+                set_journal(None)
+        sums[label] = summarize(path)["federation_scale"]
+        clients[label] = [e["clients"] for e in read_journal(path)
+                          if e.get("type") == "cohort"]
+    assert sums["fused"] == sums["seq"]
+    assert sums["fused"]["rounds"] == 4
+    assert sums["fused"]["population"] == N_CLIENTS
+    assert sums["fused"]["cohort_size"] == COHORT
+    # not just the aggregates: the per-round draws themselves match
+    assert clients["fused"] == clients["seq"]
+
+
+def test_gate_strikes_follow_cohort_sampling(fed_init16):
+    """cohort + scale_update: the poisoned client is quarantined on
+    exactly the rounds it was SAMPLED, strikes land on it alone, and the
+    quarantine mask rows align with the reported cohort ids."""
+    from fed_tgan_tpu.testing.faults import FaultPlan, install_plan
+
+    cfg = dataclasses.replace(CFG, cohort=COHORT)
+    mesh = client_mesh(8)
+    install_plan(FaultPlan.parse("scale_update:factor=1000,rank=2"))
+    try:
+        tr = FederatedTrainer(fed_init16, config=cfg, mesh=mesh, seed=13,
+                              quarantine_strikes=99)
+        m = _fit_collecting(tr, 6)
+    finally:
+        install_plan(None)
+
+    ids, q = m["cohort"], m["quarantined"] > 0
+    assert ids.shape == q.shape
+    # every quarantine hit is the faulty client (0-based idx 1)...
+    assert q.any(), "faulty client never sampled over 6 rounds (seed drift?)"
+    assert set(ids[q].ravel().tolist()) == {1}
+    # ...charged one strike per sampled-and-quarantined round, nobody else
+    expected = np.zeros(N_CLIENTS, dtype=int)
+    expected[1] = int(q.sum())
+    np.testing.assert_array_equal(tr._strikes, expected)
+    # the fault only fires on rounds client 1 was in the cohort
+    sampled = (ids == 1).any(axis=1)
+    np.testing.assert_array_equal(q.any(axis=1), sampled)
