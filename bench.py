@@ -466,6 +466,15 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
             "rounds": rounds,
             "rounds_per_program": K,
         }
+        # device work per second: the trainer ledgers the epoch program's
+        # flops on first dispatch (journal-gated), so the timed window and
+        # the program's analytic cost pair up into a utilization figure
+        from fed_tgan_tpu.obs.ledger import get_ledger
+
+        entry = get_ledger().entries().get(f"train_epoch[r{K}@{precision}]")
+        if entry is not None and entry.flops > 0:
+            result["program_flops"] = entry.flops
+            result["flops_per_s"] = round(entry.flops / K / value, 1)
         if obs_dir:
             trace_path = tracer.export(os.path.join(obs_dir, "trace.json"))
             metrics_path = os.path.join(obs_dir, "metrics.prom")
@@ -477,6 +486,10 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
                 "metrics": metrics_path,
                 "host_phases": tracer.phase_summary(),
             }
+            if profile_dir is not None:
+                # Perfetto-loadable device trace sits beside the host-side
+                # trace.json; link it so the two timeline halves stay paired
+                result["obs"]["device_trace"] = profile_dir
         return result
     finally:
         if obs_dir:
@@ -1388,6 +1401,12 @@ def bench_serving(duration_s: float = 15.0, clients: int = 4,
             "p50_ms": round(pct(0.50) * 1e3, 2),
             "p99_ms": round(pct(0.99) * 1e3, 2),
             "batch_occupancy": snap["batch_occupancy"],
+            "queue_depth": snap["queue_depth"],
+            # per-stage latency attribution: where a request's time went
+            # (queue_wait + batch_form + dispatch + decode + serialize
+            # ~= the server-side latency; the gap to the client-observed
+            # p50/p99 above is pure HTTP overhead)
+            "stages": svc.metrics.stage_snapshot(),
             "shed_retries": shed[0],
             "server_errors": snap["errors_total"],
         }
@@ -1580,6 +1599,11 @@ def bench_serving_fleet(tenants: int = 4, clients_per_tenant: int = 2,
             "quota_rps_t0": quota_rps,
             "per_tenant": per_tenant,
             "batch_occupancy": snap["batch_occupancy"],
+            "queue_depth": snap["queue_depth"],
+            "lanes_occupied": snap["lanes_occupied"],
+            # worker-side per-tenant stage attribution (queue_wait/
+            # batch_form/dispatch/decode/serialize p50+p99)
+            "stages": svc.metrics.stage_snapshots(),
             "lane_dispatches": snap["lane_dispatches_total"],
             "lane_requests": snap["lane_requests_total"],
             "hot_reloads": sum(

@@ -28,6 +28,7 @@ import threading
 from typing import Dict, List, Optional
 
 from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.ledger import note_compile as _note_compile
 
 __all__ = [
     "CompileCounter",
@@ -72,6 +73,11 @@ class CompileCounter(logging.Handler):
             # under the handler's own lock
             self.events.append(m.group(1))  # jaxlint: disable=J05
             _emit_event("compile", program=m.group(1))
+            # live-compile feed for the process-wide cost ledger: the
+            # AOT pass records analysis figures, this records the fact
+            # that (and how often) the program compiled in vivo
+            if m.group(1) not in _NOISE:
+                _note_compile(m.group(1))
 
     # ----------------------------------------------------------- queries
 

@@ -799,6 +799,72 @@ def check_observability() -> bool:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def check_cost_ledger(timeout: int = 300) -> bool:
+    """The device cost ledger reports real figures and the SLO gate
+    accepts the repo's own checked-in bench records.
+
+    Two subprocesses (lowering must own backend init, like the contract
+    gate): ``obs ledger`` compiles one contracted family and every entry
+    must carry nonzero flops / bytes-accessed / peak bytes; then ``obs
+    slo`` replays the checked-in fleet and cohort bench records against
+    the packaged budgets -- exit 0 means the budgets still describe the
+    artifacts this repo ships."""
+    import json
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "fed_tgan_tpu.obs", "ledger", "--json",
+             "--family", "train_federated"],
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=root,
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "cost-ledger", f"timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stdout or proc.stderr or "").strip().splitlines()[-2:]
+        return _line(False, "cost-ledger",
+                     "obs ledger failed: " + (" | ".join(tail)
+                                              or f"rc={proc.returncode}"))
+    try:
+        entries = json.loads(proc.stdout)
+    except ValueError:
+        return _line(False, "cost-ledger", "obs ledger emitted non-JSON")
+    if not entries:
+        return _line(False, "cost-ledger", "obs ledger returned no entries")
+    hollow = [n for n, e in entries.items()
+              if not (e.get("flops") and e.get("bytes_accessed")
+                      and e.get("peak_bytes"))]
+    if hollow:
+        return _line(False, "cost-ledger",
+                     f"zero-cost entries: {sorted(hollow)[:3]}")
+    checked = []
+    for rec in ("BENCH_r09.json", "BENCH_r10.json"):
+        path = os.path.join(root, rec)
+        if not os.path.exists(path):
+            continue  # bench records are repo artifacts, not a package part
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "fed_tgan_tpu.obs", "slo", path],
+                capture_output=True, text=True, timeout=timeout, cwd=root,
+            )
+        except subprocess.TimeoutExpired:
+            return _line(False, "cost-ledger",
+                         f"obs slo {rec} timed out after {timeout}s")
+        if proc.returncode != 0:
+            tail = (proc.stdout or "").strip().splitlines()[-2:]
+            return _line(False, "cost-ledger",
+                         f"obs slo {rec} rc={proc.returncode}: "
+                         + " | ".join(tail))
+        checked.append(rec)
+    slo_note = (f"slo gate passed {', '.join(checked)}" if checked
+                else "no bench records on disk; slo gate skipped")
+    return _line(True, "cost-ledger",
+                 f"{len(entries)} train_federated programs with nonzero "
+                 f"flops/bytes/peak; {slo_note}")
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -850,6 +916,7 @@ def main(argv=None) -> int:
         check_scan_rounds(),
         check_cohort_scale(),
         check_observability(),
+        check_cost_ledger(),
         check_serving(),
         check_serving_fleet(),
     ]

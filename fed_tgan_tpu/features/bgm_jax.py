@@ -31,6 +31,8 @@ from functools import partial
 
 import numpy as np
 
+from fed_tgan_tpu.obs.trace import span as _span
+
 N_KMEANS_ITERS = 20
 
 
@@ -184,10 +186,11 @@ def fit_columns_jax(
     )
     # one batched transfer for all seven result arrays (jaxlint J01),
     # then the float64 view is a host-side dtype conversion
-    means, stds, weights, mean_prec, dof, stick_a, stick_b = (
-        np.asarray(r, dtype=np.float64)
-        for r in jax.device_get(fit(jnp.asarray(xs), jnp.asarray(masks)))
-    )
+    with _span("init.bgm_fit_jax", columns=len(cols), n_max=n_max):
+        means, stds, weights, mean_prec, dof, stick_a, stick_b = (
+            np.asarray(r, dtype=np.float64)
+            for r in jax.device_get(fit(jnp.asarray(xs), jnp.asarray(masks)))
+        )
     out = []
     for i in range(len(cols)):
         w = weights[i]

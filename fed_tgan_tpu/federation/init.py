@@ -25,6 +25,7 @@ This phase is object-valued, one-time and cold, so it stays on host
 from __future__ import annotations
 
 import copy
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -43,6 +44,8 @@ from fed_tgan_tpu.features.bgm import (
     resolved_init_workers,
 )
 from fed_tgan_tpu.features.transformer import ModeNormalizer
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.trace import span as _span
 
 
 def _normalize_per_column(dist: np.ndarray, n_clients: int) -> np.ndarray:
@@ -245,42 +248,69 @@ def federated_initialize(
     uniform FedAvg weights (the reference's ``average_model_ordinary``).
     """
     n_clients = len(clients)
-    local_metas = [c.local_meta() for c in clients]
 
-    global_meta_dict, encoders, jsd = harmonize_categories(local_metas)
+    # each protocol phase is spanned + journaled (`init_phase`) so
+    # `obs report` can decompose the onboarding wall at scale -- the
+    # clocks are host-side (this whole path is numpy/sklearn)
+    def _phase_done(phase: str, t0: float) -> None:
+        _emit_event("init_phase", phase=phase,
+                    seconds=round(time.perf_counter() - t0, 6),
+                    clients=n_clients)
 
-    encoded = [c.encode(encoders) for c in clients]
-    matrices = [m for m, _, _ in encoded]
-    cat_idx = encoded[0][1]
-    rows_per_client = [len(m) for m in matrices]
+    t0 = time.perf_counter()
+    with _span("init.category_harmonize", clients=n_clients):
+        local_metas = [c.local_meta() for c in clients]
+        global_meta_dict, encoders, jsd = harmonize_categories(local_metas)
+    _phase_done("category_harmonize", t0)
 
-    # local per-column GMM fits (client-side in the reference)
-    local_tfs = [
-        ModeNormalizer(backend=backend, seed=seed).fit(m, cat_idx)
-        for m in matrices
-    ]
-    client_gmms = [tf.column_gmms for tf in local_tfs]
+    t0 = time.perf_counter()
+    with _span("init.encode", clients=n_clients):
+        encoded = [c.encode(encoders) for c in clients]
+        matrices = [m for m, _, _ in encoded]
+        cat_idx = encoded[0][1]
+        rows_per_client = [len(m) for m in matrices]
+    _phase_done("encode", t0)
 
-    global_gmms, wd = harmonize_continuous(
-        client_gmms, rows_per_client, seed=seed, backend=backend
-    )
+    # local per-column GMM fits (client-side in the reference) -- the
+    # dominant init cost at scale (one BGM fit per client per column)
+    t0 = time.perf_counter()
+    with _span("init.local_bgm_fit", clients=n_clients):
+        local_tfs = [
+            ModeNormalizer(backend=backend, seed=seed).fit(m, cat_idx)
+            for m in matrices
+        ]
+        client_gmms = [tf.column_gmms for tf in local_tfs]
+    _phase_done("local_bgm_fit", t0)
 
-    global_meta = TableMeta.from_json_dict(global_meta_dict)
-    transformers = []
-    client_matrices = []
-    for i in range(n_clients):
-        tf = ModeNormalizer(backend=backend, seed=seed).refit_with_global(
-            global_meta, encoders, global_gmms
+    t0 = time.perf_counter()
+    with _span("init.continuous_harmonize", clients=n_clients):
+        global_gmms, wd = harmonize_continuous(
+            client_gmms, rows_per_client, seed=seed, backend=backend
         )
-        transformers.append(tf)
-        client_matrices.append(
-            tf.transform(matrices[i], rng=np.random.default_rng(seed + i))
-        )
+    _phase_done("continuous_harmonize", t0)
 
-    if weighted:
-        weights = aggregation_weights(jsd, wd, rows_per_client)
-    else:
-        weights = np.full(n_clients, 1.0 / n_clients)
+    t0 = time.perf_counter()
+    with _span("init.refit_transform", clients=n_clients):
+        global_meta = TableMeta.from_json_dict(global_meta_dict)
+        transformers = []
+        client_matrices = []
+        for i in range(n_clients):
+            tf = ModeNormalizer(backend=backend, seed=seed).refit_with_global(
+                global_meta, encoders, global_gmms
+            )
+            transformers.append(tf)
+            client_matrices.append(
+                tf.transform(matrices[i], rng=np.random.default_rng(seed + i))
+            )
+    _phase_done("refit_transform", t0)
+
+    t0 = time.perf_counter()
+    with _span("init.aggregation_weights", clients=n_clients):
+        if weighted:
+            weights = aggregation_weights(jsd, wd, rows_per_client)
+        else:
+            weights = np.full(n_clients, 1.0 / n_clients)
+    _phase_done("aggregation_weights", t0)
 
     return FederatedInit(
         global_meta=global_meta,

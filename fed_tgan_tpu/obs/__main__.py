@@ -4,10 +4,19 @@ Subcommands:
 
 - ``report <journal.jsonl> [--format text|json]`` -- summarize a run
   journal (rounds, watchdog, robustness, transport, compiles,
-  checkpoints).
+  checkpoints, program costs, init phases, serving stages).
+- ``slo <bench-or-journal> [--budgets FILE]`` -- SLO regression gate:
+  check a bench record / journal against checked-in budgets.  Exit 1
+  on a regression, 0 on pass (stale-budget improvements warn), 2 on
+  malformed input/budgets.
+- ``ledger [--json] [--family F]`` -- compile the hlolint-contracted
+  programs and print their device cost ledger.  This subcommand (and
+  only this one) imports jax.
 
-Exit codes: 0 ok, 2 usage / unreadable journal.  Pure stdlib -- never
-imports jax.
+Exit codes: 0 ok, 1 SLO regression, 2 usage / unreadable input.  The
+module itself stays pure stdlib at import time -- ``report`` and
+``slo`` never import jax; ``ledger`` imports it lazily inside the
+handler.
 """
 
 from __future__ import annotations
@@ -15,6 +24,7 @@ from __future__ import annotations
 import argparse
 
 from fed_tgan_tpu.obs.report import report_main
+from fed_tgan_tpu.obs.slo import slo_main
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -26,6 +36,17 @@ def build_parser() -> argparse.ArgumentParser:
     rep = sub.add_parser("report", help="summarize a run journal (JSONL)")
     rep.add_argument("journal", help="path to the journal JSONL file")
     rep.add_argument("--format", choices=("text", "json"), default="text")
+    slo = sub.add_parser(
+        "slo", help="check a bench record or journal against SLO budgets")
+    slo.add_argument("input", help="bench record JSON or journal JSONL")
+    slo.add_argument("--budgets", default=None,
+                     help="budget file (default: packaged obs/budgets.json)")
+    led = sub.add_parser(
+        "ledger", help="compile contracted programs, print the cost ledger")
+    led.add_argument("--json", action="store_true",
+                     help="emit the ledger as JSON")
+    led.add_argument("--family", action="append", default=None,
+                     help="restrict to one entrypoint family (repeatable)")
     return ap
 
 
@@ -33,6 +54,15 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "report":
         return report_main(args.journal, fmt=args.format)
+    if args.cmd == "slo":
+        return slo_main(args)
+    if args.cmd == "ledger":
+        # lazy: the ledger pass compiles programs, so only it pulls jax
+        from fed_tgan_tpu.obs.ledger import ledger_main
+
+        return ledger_main(["--json"] * bool(args.json)
+                           + sum((["--family", f]
+                                  for f in args.family or ()), []))
     return 2
 
 

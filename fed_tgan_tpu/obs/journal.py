@@ -38,6 +38,12 @@ fleet_load                fleet admin loaded a tenant model
 fleet_evict               fleet admin evicted a tenant model
 tenant_shed               per-tenant admission shed requests (rate-limited
                           summary event carrying counts, never per-request)
+program_cost              compiled-program cost ledger entry (flops, bytes
+                          accessed, peak/argument/output/temp bytes)
+init_phase                federated onboarding phase finished (phase name,
+                          seconds, client count)
+serve_stages              per-stage serving latency summary (rate-limited:
+                          stage means/counts since the last event)
 ========================  ====================================================
 
 Writers go through a process-wide current journal: ``set_journal``
@@ -78,6 +84,7 @@ EVENT_TYPES = frozenset({
     "transport_reconnect", "transport_drop", "heartbeat_lapse",
     "compile", "backend_probe", "device_trace", "serve_reload",
     "fleet_load", "fleet_evict", "tenant_shed",
+    "program_cost", "init_phase", "serve_stages",
 })
 
 

@@ -1,0 +1,300 @@
+"""Program cost ledger: per-compiled-program FLOP / memory accounting.
+
+The obs layer so far (PR 6) measures *host* time -- spans, counters,
+journal events.  This module adds the *device* side: every compiled
+program gets a :class:`CostEntry` built from the XLA compiler's own
+``cost_analysis()`` / ``memory_analysis()`` figures (flops, bytes
+accessed, argument/output/temp bytes, generated-code size) so perf work
+can compare programs against a recorded baseline instead of guessing.
+
+Two feeds populate the process-wide ledger:
+
+- **AOT**: :func:`contract_cost_ledger` lowers + compiles the same 37
+  contracted entrypoints the hlolint harness fingerprints
+  (``analysis/contracts/harness.ENTRYPOINT_FAMILIES``) and records one
+  entry per program, emitting a ``program_cost`` journal event each.
+- **live**: the sanitizers' ``CompileCounter`` calls
+  :func:`note_compile` for every compile XLA logs, so programs that
+  compile outside the contract set still show up (with count-only
+  entries until someone records their analysis figures).
+
+Import contract: this module is pure stdlib at import time -- jax is
+imported *inside* the functions that need it.  That keeps
+``fed_tgan_tpu.obs`` importable before jax (doctor enforces it) and
+makes the ``sanitizers -> ledger`` import cycle-free.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+
+__all__ = [
+    "CostEntry",
+    "CostLedger",
+    "contract_cost_ledger",
+    "entry_from_lowered",
+    "get_ledger",
+    "note_compile",
+]
+
+
+@dataclass
+class CostEntry:
+    """Compiler-reported cost figures for one compiled program.
+
+    ``flops`` / ``bytes_accessed`` / ``transcendentals`` come from
+    ``cost_analysis()``; the byte-level fields from
+    ``memory_analysis()``.  ``peak_bytes`` is the derived live-memory
+    ceiling (arguments + outputs + temps + generated code -- XLA does
+    not export a single peak-HBM figure through the AOT API, and on
+    CPU ``generated_code`` may legitimately be 0).  ``donated_bytes``
+    is the argument memory aliased into outputs (``alias_size``), i.e.
+    what buffer donation saved.  ``compiles`` counts live compiles the
+    sanitizers observed for this program name.
+    """
+
+    name: str
+    family: str = ""
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    transcendentals: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    generated_code_bytes: int = 0
+    donated_bytes: int = 0
+    peak_bytes: int = 0
+    compiles: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "family": self.family,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "transcendentals": self.transcendentals,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "donated_bytes": self.donated_bytes,
+            "peak_bytes": self.peak_bytes,
+            "compiles": self.compiles,
+        }
+
+
+def _cost_dict(analysis) -> dict:
+    """Normalize ``cost_analysis()`` output.
+
+    jax's ``Lowered.cost_analysis()`` returns a plain dict;
+    ``Compiled.cost_analysis()`` returns a *list* of per-device dicts
+    on some jaxlib versions.  Accept both (and None on backends that
+    don't implement it).
+    """
+    if analysis is None:
+        return {}
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    return dict(analysis)
+
+
+def entry_from_lowered(name: str, lowered, family: str = "",
+                       do_compile: bool = True) -> CostEntry:
+    """Build a :class:`CostEntry` from a ``jax.stages.Lowered``.
+
+    ``cost_analysis()`` works pre-compile; the memory figures need
+    ``lowered.compile()``.  Both analyses are best-effort -- a backend
+    that raises (or reports nothing) yields zeros for its fields rather
+    than failing the whole ledger pass.
+    """
+    entry = CostEntry(name=name, family=family)
+    try:
+        cost = _cost_dict(lowered.cost_analysis())
+    except Exception:
+        cost = {}
+    entry.flops = float(cost.get("flops", 0.0) or 0.0)
+    entry.bytes_accessed = float(cost.get("bytes accessed", 0.0) or 0.0)
+    entry.transcendentals = float(cost.get("transcendentals", 0.0) or 0.0)
+    if not do_compile:
+        return entry
+    try:
+        compiled = lowered.compile()
+    except Exception:
+        return entry
+    try:
+        cost = _cost_dict(compiled.cost_analysis())
+        # the compiled figures supersede the lowered estimate when the
+        # backend reports them (post-fusion numbers are the real cost)
+        if cost.get("flops"):
+            entry.flops = float(cost["flops"])
+        if cost.get("bytes accessed"):
+            entry.bytes_accessed = float(cost["bytes accessed"])
+        if cost.get("transcendentals"):
+            entry.transcendentals = float(cost["transcendentals"])
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is not None:
+        entry.argument_bytes = int(
+            getattr(mem, "argument_size_in_bytes", 0) or 0)
+        entry.output_bytes = int(
+            getattr(mem, "output_size_in_bytes", 0) or 0)
+        entry.temp_bytes = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+        entry.generated_code_bytes = int(
+            getattr(mem, "generated_code_size_in_bytes", 0) or 0)
+        entry.donated_bytes = int(
+            getattr(mem, "alias_size_in_bytes", 0) or 0)
+    # live-memory ceiling: everything resident while the program runs,
+    # minus the donated argument bytes that alias into outputs
+    entry.peak_bytes = max(0, entry.argument_bytes + entry.output_bytes
+                           + entry.temp_bytes + entry.generated_code_bytes
+                           - entry.donated_bytes)
+    return entry
+
+
+class CostLedger:
+    """Thread-safe name -> :class:`CostEntry` map.
+
+    ``record`` installs/merges analysis figures; ``note_compile`` (the
+    sanitizers' hook) bumps the live-compile count, creating a bare
+    entry for programs the AOT pass never saw.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, CostEntry] = {}
+
+    def record(self, entry: CostEntry) -> CostEntry:
+        with self._lock:
+            prev = self._entries.get(entry.name)
+            if prev is not None:
+                entry.compiles = prev.compiles
+            self._entries[entry.name] = entry
+        return entry
+
+    def note_compile(self, name: str) -> None:
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                entry = CostEntry(name=name)
+                self._entries[name] = entry
+            entry.compiles += 1
+
+    def entries(self) -> Dict[str, CostEntry]:
+        with self._lock:
+            return dict(self._entries)
+
+    def snapshot(self) -> dict:
+        """JSON-shaped dump: {name: entry dict}, stable key order."""
+        entries = self.entries()
+        return {name: entries[name].to_dict() for name in sorted(entries)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_LEDGER = CostLedger()
+
+
+def get_ledger() -> CostLedger:
+    """The process-wide ledger (sanitizers and bench share it)."""
+    return _LEDGER
+
+
+def note_compile(name: str) -> None:
+    """Module-level convenience for the sanitizers' CompileCounter."""
+    _LEDGER.note_compile(name)
+
+
+def contract_cost_ledger(
+    families: Optional[Dict[str, Dict[str, Callable]]] = None,
+    ledger: Optional[CostLedger] = None,
+    journal: bool = True,
+) -> Dict[str, CostEntry]:
+    """Lower + compile every contracted entrypoint and ledger its cost.
+
+    Reuses the hlolint harness registry (``ENTRYPOINT_FAMILIES``) so
+    the ledger's program set is exactly the contracted one; requires
+    the same 8-device mesh.  Each program emits a ``program_cost``
+    journal event when a journal is installed.  Returns the recorded
+    entries keyed by program name.
+    """
+    from fed_tgan_tpu.analysis.contracts.harness import (
+        ENTRYPOINT_FAMILIES,
+        require_mesh,
+    )
+
+    require_mesh()
+    ledger = ledger if ledger is not None else get_ledger()
+    out: Dict[str, CostEntry] = {}
+    for family, programs in (families or ENTRYPOINT_FAMILIES).items():
+        for name, build in programs.items():
+            entry = entry_from_lowered(name, build(), family=family)
+            ledger.record(entry)
+            out[name] = entry
+            if journal:
+                _emit_event("program_cost", **entry.to_dict())
+    return out
+
+
+def ledger_main(argv=None) -> int:
+    """``python -m fed_tgan_tpu.obs ledger [--json] [--family F ...]``
+
+    Compiles the contracted programs (this imports jax and provisions
+    the 8-device virtual CPU mesh when needed) and prints the ledger.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="fed_tgan_tpu.obs ledger",
+        description="compile the contracted programs and print their "
+                    "device cost ledger")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the ledger as JSON")
+    parser.add_argument("--family", action="append", default=None,
+                        help="restrict to one entrypoint family "
+                             "(repeatable)")
+    args = parser.parse_args(argv)
+    try:
+        from fed_tgan_tpu.analysis.contracts.harness import (
+            ENTRYPOINT_FAMILIES,
+            HarnessError,
+        )
+    except Exception as exc:
+        print(f"ledger: harness unavailable: {exc!r}")
+        return 2
+    families = None
+    if args.family:
+        unknown = [f for f in args.family if f not in ENTRYPOINT_FAMILIES]
+        if unknown:
+            print(f"ledger: unknown families {unknown}; "
+                  f"known: {sorted(ENTRYPOINT_FAMILIES)}")
+            return 2
+        families = {f: ENTRYPOINT_FAMILIES[f] for f in args.family}
+    try:
+        entries = contract_cost_ledger(families=families, journal=False)
+    except HarnessError as exc:
+        print(f"ledger: {exc}")
+        return 2
+    if args.json:
+        print(json.dumps({n: e.to_dict() for n, e in entries.items()},
+                         indent=2, sort_keys=True))
+        return 0
+    print(f"{'program':<38} {'family':<16} {'Mflops':>10} "
+          f"{'MB accessed':>12} {'peak MB':>9} {'donated MB':>11}")
+    for name in sorted(entries):
+        e = entries[name]
+        print(f"{name:<38} {e.family:<16} {e.flops / 1e6:>10.2f} "
+              f"{e.bytes_accessed / 1e6:>12.2f} "
+              f"{e.peak_bytes / 1e6:>9.2f} "
+              f"{e.donated_bytes / 1e6:>11.2f}")
+    return 0

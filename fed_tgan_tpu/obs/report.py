@@ -157,6 +157,72 @@ def summarize(path: str) -> dict:
             "shed_by_tenant": dict(sorted(shed_by_tenant.items())),
         }
 
+    costs = [e for e in events if e.get("type") == "program_cost"]
+    traces = [e for e in events if e.get("type") == "device_trace"]
+    if costs or traces:
+        # last program_cost event per program wins (re-ledgering a
+        # program supersedes the earlier figures)
+        per_cost: Dict[str, dict] = {}
+        for e in costs:
+            name = str(e.get("name", "?"))
+            per_cost[name] = {
+                "family": e.get("family", ""),
+                "flops": e.get("flops", 0),
+                "bytes_accessed": e.get("bytes_accessed", 0),
+                "peak_bytes": e.get("peak_bytes", 0),
+                "donated_bytes": e.get("donated_bytes", 0),
+                "compiles": e.get("compiles", 0),
+            }
+        out["programs"] = {
+            "ledgered": len(per_cost),
+            "total_flops": sum(float(c["flops"] or 0)
+                               for c in per_cost.values()),
+            "peak_bytes_max": max(
+                (int(c["peak_bytes"] or 0) for c in per_cost.values()),
+                default=0),
+            "per_program": dict(sorted(per_cost.items())),
+            # the profiling satellite: device_trace outcomes belong to
+            # the program view -- the trace dir is where the per-program
+            # device timelines actually live
+            "device_traces": [
+                {"dir": e.get("dir"), "ok": bool(e.get("ok", False)),
+                 "error": e.get("error")}
+                for e in traces
+            ],
+        }
+
+    inits = [e for e in events if e.get("type") == "init_phase"]
+    if inits:
+        per_phase: Dict[str, dict] = {}
+        for e in inits:
+            phase = str(e.get("phase", "?"))
+            d = per_phase.setdefault(phase, {"seconds": 0.0, "count": 0})
+            d["seconds"] += float(e.get("seconds", 0) or 0)
+            d["count"] += 1
+        for d in per_phase.values():
+            d["seconds"] = round(d["seconds"], 3)
+        out["init"] = {
+            "total_seconds": round(sum(d["seconds"]
+                                       for d in per_phase.values()), 3),
+            "phases": dict(sorted(per_phase.items(),
+                                  key=lambda kv: -kv[1]["seconds"])),
+        }
+
+    stage_evs = [e for e in events if e.get("type") == "serve_stages"]
+    if stage_evs:
+        per_stage: Dict[str, dict] = {}
+        for e in stage_evs:
+            for stage, st in (e.get("stages") or {}).items():
+                if not isinstance(st, dict):
+                    continue
+                d = per_stage.setdefault(
+                    str(stage), {"count": 0, "p50_ms": 0.0, "p99_ms": 0.0})
+                d["count"] += int(st.get("count", 0) or 0)
+                # worst window observed -- the operator wants the spikes
+                d["p50_ms"] = max(d["p50_ms"], float(st.get("p50_ms", 0) or 0))
+                d["p99_ms"] = max(d["p99_ms"], float(st.get("p99_ms", 0) or 0))
+        out["serve_stages"] = dict(sorted(per_stage.items()))
+
     probes = [e for e in events if e.get("type") == "backend_probe"]
     if probes:
         out["backend_probes"] = {
@@ -221,6 +287,33 @@ def render_text(summary: dict) -> str:
                      f"{sv['fleet_evicts']} evict(s)"
                      + (f", shed by tenant {sv['shed_by_tenant']}"
                         if sv["shed_by_tenant"] else ""))
+    pg = summary.get("programs")
+    if pg:
+        lines.append(f"  programs: {pg['ledgered']} ledgered, "
+                     f"{pg['total_flops'] / 1e6:.2f} Mflops total, "
+                     f"peak {pg['peak_bytes_max'] / 1e6:.2f} MB")
+        for name, c in pg.get("per_program", {}).items():
+            lines.append(
+                f"    {name:<38} {float(c['flops'] or 0) / 1e6:>9.2f} Mflop "
+                f"{float(c['bytes_accessed'] or 0) / 1e6:>9.2f} MB acc "
+                f"{int(c['peak_bytes'] or 0) / 1e6:>7.2f} MB peak "
+                f"x{c['compiles']}")
+        for t in pg.get("device_traces", []):
+            status = "ok" if t["ok"] else f"FAILED ({t.get('error')})"
+            lines.append(f"    device trace: {t.get('dir')} [{status}]")
+    ini = summary.get("init")
+    if ini:
+        lines.append(f"  init: {ini['total_seconds']}s across "
+                     f"{len(ini['phases'])} phase(s)")
+        for phase, d in ini["phases"].items():
+            lines.append(f"    {phase:<32} {d['seconds']:>9.3f}s "
+                         f"x{d['count']}")
+    ss = summary.get("serve_stages")
+    if ss:
+        lines.append("  serving stages (worst window):")
+        for stage, d in ss.items():
+            lines.append(f"    {stage:<12} p50 {d['p50_ms']:>8.2f} ms  "
+                         f"p99 {d['p99_ms']:>8.2f} ms  n={d['count']}")
     bp = summary.get("backend_probes")
     if bp:
         lines.append(f"  backend probes: {bp['total']} "

@@ -1,0 +1,263 @@
+"""SLO regression gate: check bench records / journals against budgets.
+
+``python -m fed_tgan_tpu.obs slo <input> [--budgets FILE]`` reads one
+input -- a bench record JSON (single record or a ``{"records": [...]}``
+bundle like ``BENCH_r07.json``) or a run-journal JSONL -- and checks it
+against the checked-in budget file.  The exit-code policy mirrors the
+hlolint contract checker:
+
+- **regression** (a budget violated)  -> exit 1
+- **improvement** far inside a budget -> exit 0 + a *stale budget*
+  warning telling the owner to re-seed the number
+- pass / nothing matched              -> exit 0
+- malformed budgets or input          -> exit 2
+
+Budget file shape (``obs/budgets.json`` is the packaged default)::
+
+    {"schema": 1, "budgets": [
+        {"name": "serving-p99",              # unique label for output
+         "select": {"metric_prefix": "bench_serving("},  # optional
+         "metric": "p99_ms",                 # dotted path / figure key
+         "max": 35.0,                        # or "min": <floor>
+         "stale_frac": 0.4},                 # optional staleness knobs
+        ...]}
+
+For bench inputs ``metric`` is a dotted path into the record
+(``per_tenant.t0.p99_ms``); ``select.metric_prefix`` restricts the rule
+to records whose ``metric`` string starts with the prefix.  For journal
+inputs the events are first folded into flat figures:
+
+- ``program_cost``  -> ``program/<name>/flops|bytes_accessed|peak_bytes``
+  (last event per program wins)
+- ``serve_stages``  -> ``stage/<stage>/p99_ms|p50_ms`` (worst observed)
+- ``init_phase``    -> ``init/<phase>/seconds`` (summed)
+
+and ``metric`` is looked up as an exact figure key (program names may
+contain dots/brackets, so no dotted traversal on journal figures).
+
+Pure stdlib -- never imports jax; safe for CI front doors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["check_slo", "default_budgets_path", "load_budgets", "slo_main"]
+
+#: improvement thresholds that flag a budget as stale (overridable
+#: per-rule): a value under ``stale_frac * max`` or over
+#: ``stale_mult * min`` means the budget no longer bounds anything.
+STALE_FRAC = 0.4
+STALE_MULT = 2.5
+
+
+class SLOError(Exception):
+    """Malformed budgets or input -- maps to exit code 2."""
+
+
+def default_budgets_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "budgets.json")
+
+
+def load_budgets(path: str) -> List[dict]:
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        raise SLOError(f"cannot read budgets {path!r}: {exc}") from exc
+    if not isinstance(doc, dict) or not isinstance(doc.get("budgets"), list):
+        raise SLOError(f"budgets {path!r}: expected "
+                       '{"budgets": [...]} document')
+    rules = doc["budgets"]
+    for i, rule in enumerate(rules):
+        if not isinstance(rule, dict) or "metric" not in rule:
+            raise SLOError(f"budgets {path!r}: rule #{i} needs a 'metric'")
+        if "min" not in rule and "max" not in rule:
+            raise SLOError(f"budgets {path!r}: rule "
+                           f"{rule.get('name', i)!r} needs 'min' or 'max'")
+    return rules
+
+
+# ------------------------------------------------------------------ input
+
+
+def _load_input(path: str) -> Tuple[str, object]:
+    """Classify the input file: ('bench', [records]) or
+    ('journal', [events])."""
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError as exc:
+        raise SLOError(f"cannot read input {path!r}: {exc}") from exc
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        if isinstance(doc.get("records"), list):
+            recs = [r for r in doc["records"] if isinstance(r, dict)]
+            if recs:
+                return "bench", recs
+        if "metric" in doc:
+            return "bench", [doc]
+        raise SLOError(f"input {path!r}: JSON object is neither a bench "
+                       "record nor a records bundle")
+    # JSONL journal
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail line
+        if isinstance(ev, dict) and "type" in ev:
+            events.append(ev)
+    if not events:
+        raise SLOError(f"input {path!r}: not a bench record and no "
+                       "journal events parsed")
+    return "journal", events
+
+
+def journal_figures(events: List[dict]) -> Dict[str, float]:
+    """Fold journal events into the flat figure map the rules read."""
+    figures: Dict[str, float] = {}
+    for ev in events:
+        kind = ev.get("type")
+        if kind == "program_cost":
+            name = ev.get("name")
+            if not name:
+                continue
+            for k in ("flops", "bytes_accessed", "peak_bytes",
+                      "argument_bytes", "temp_bytes"):
+                if k in ev:
+                    figures[f"program/{name}/{k}"] = float(ev[k] or 0)
+        elif kind == "serve_stages":
+            stages = ev.get("stages")
+            if not isinstance(stages, dict):
+                continue
+            for stage, st in stages.items():
+                if not isinstance(st, dict):
+                    continue
+                for k in ("p50_ms", "p99_ms"):
+                    if k in st:
+                        key = f"stage/{stage}/{k}"
+                        val = float(st[k] or 0)
+                        figures[key] = max(figures.get(key, 0.0), val)
+        elif kind == "init_phase":
+            phase = ev.get("phase")
+            if not phase:
+                continue
+            key = f"init/{phase}/seconds"
+            figures[key] = figures.get(key, 0.0) + float(
+                ev.get("seconds", 0) or 0)
+    return figures
+
+
+def _dotted(record: dict, path: str):
+    cur: object = record
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+# ------------------------------------------------------------------ check
+
+
+def _check_rule(rule: dict, value: float, where: str,
+                lines: List[str]) -> Tuple[int, int]:
+    """Returns (regressions, stale_warnings) for one matched value."""
+    name = rule.get("name", rule["metric"])
+    reg = stale = 0
+    if "max" in rule:
+        ceil = float(rule["max"])
+        if value > ceil:
+            lines.append(f"REGRESSION {name}: {value:g} > max {ceil:g} "
+                         f"({where})")
+            reg += 1
+        elif value < ceil * float(rule.get("stale_frac", STALE_FRAC)):
+            lines.append(f"stale budget {name}: {value:g} is far below "
+                         f"max {ceil:g} ({where}) -- re-seed the budget "
+                         "to lock in the improvement")
+            stale += 1
+        else:
+            lines.append(f"ok {name}: {value:g} <= max {ceil:g} ({where})")
+    if "min" in rule:
+        floor = float(rule["min"])
+        if value < floor:
+            lines.append(f"REGRESSION {name}: {value:g} < min {floor:g} "
+                         f"({where})")
+            reg += 1
+        elif value > floor * float(rule.get("stale_mult", STALE_MULT)):
+            lines.append(f"stale budget {name}: {value:g} is far above "
+                         f"min {floor:g} ({where}) -- re-seed the budget "
+                         "to lock in the improvement")
+            stale += 1
+        else:
+            lines.append(f"ok {name}: {value:g} >= min {floor:g} ({where})")
+    return reg, stale
+
+
+def check_slo(input_path: str, budgets_path: str) -> Tuple[int, List[str]]:
+    """Check one input against the budget file.
+
+    Returns ``(exit_code, report_lines)``; raises :class:`SLOError`
+    (exit 2 territory) on malformed budgets or input.
+    """
+    rules = load_budgets(budgets_path)
+    kind, payload = _load_input(input_path)
+    lines: List[str] = []
+    regressions = stale = matched = 0
+    if kind == "bench":
+        records: List[dict] = payload  # type: ignore[assignment]
+        for rule in rules:
+            select = rule.get("select") or {}
+            prefix = select.get("metric_prefix", "")
+            for rec in records:
+                metric = str(rec.get("metric", ""))
+                if prefix and not metric.startswith(prefix):
+                    continue
+                value = _dotted(rec, rule["metric"])
+                if not isinstance(value, (int, float)):
+                    continue
+                matched += 1
+                r, s = _check_rule(rule, float(value), metric, lines)
+                regressions += r
+                stale += s
+    else:
+        figures = journal_figures(payload)  # type: ignore[arg-type]
+        for rule in rules:
+            value = figures.get(rule["metric"])
+            if value is None:
+                continue
+            matched += 1
+            r, s = _check_rule(rule, value, "journal", lines)
+            regressions += r
+            stale += s
+    if not matched:
+        lines.append(f"warning: no budget rule matched {input_path!r} "
+                     f"({kind} input, {len(rules)} rules)")
+    summary = (f"slo: {matched} checked, {regressions} regressions, "
+               f"{stale} stale budgets")
+    lines.append(summary)
+    return (1 if regressions else 0), lines
+
+
+def slo_main(args) -> int:
+    """Entry point for the ``obs slo`` subcommand (argparse namespace
+    with ``input`` and ``budgets``)."""
+    budgets = args.budgets or default_budgets_path()
+    try:
+        code, lines = check_slo(args.input, budgets)
+    except SLOError as exc:
+        print(f"slo: {exc}")
+        return 2
+    for line in lines:
+        print(line)
+    return code

@@ -14,8 +14,10 @@ star needs:
   bit-identical to one N-row draw);
 - ``service``    — stdlib-only HTTP server with a bounded queue,
   micro-batch coalescing, load shedding, and graceful drain;
-- ``metrics``    — request latency / queue depth / batch occupancy /
-  rows-per-second counters behind ``/healthz`` and ``/metrics``;
+- ``metrics``    — request latency (end-to-end and per lifecycle stage:
+  queue_wait/batch_form/dispatch/decode/serialize), queue-depth and
+  lane-occupancy gauges, batch occupancy, and rows-per-second counters
+  behind ``/healthz`` and ``/metrics``;
 - ``demo``       — a tiny self-contained artifact builder the doctor
   check, serving bench, and tests share.
 """

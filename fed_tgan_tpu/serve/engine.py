@@ -35,6 +35,7 @@ per dispatch — the donation alias is a contract requirement
 from __future__ import annotations
 
 import threading
+import time
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -349,14 +350,19 @@ class SamplingEngine:
 
     def sample_decoded(self, n: int, seed: int = 0, offset: int = 0,
                        condition: Optional[int] = None,
-                       snap: Optional[EngineSnapshot] = None) -> np.ndarray:
+                       snap: Optional[EngineSnapshot] = None,
+                       stages: Optional[dict] = None) -> np.ndarray:
         """Rows [offset, offset + n) of stream ``seed`` as the decoded
         numeric (n, n_columns) matrix (device decode, float32).
 
         ``condition``: a position from :meth:`resolve_condition`, or None
         for the empirical conditional draw (the reference's sampling).
         ``snap``: an :class:`EngineSnapshot` to sample against (defaults
-        to a fresh one) — the whole multi-chunk draw reads ONE model."""
+        to a fresh one) — the whole multi-chunk draw reads ONE model.
+        ``stages``: optional stage-attribution accumulator ({stage:
+        seconds}, see :data:`~.metrics.STAGES`) — host ``perf_counter``
+        deltas only, never a device sync, so it composes with the
+        sanitizers' transfer guard."""
         import jax
 
         if n <= 0:
@@ -380,6 +386,7 @@ class SamplingEngine:
             self._scratch_give(buf)  # rotate it back in as donated scratch
             return host
 
+        t_dispatch = time.perf_counter()
         for start, steps in self._chunk_plan(first_step, total_steps):
             # double-buffered like SampleProgramCache.sample: chunk i+1
             # computes while chunk i transfers, at most 2 buffers live
@@ -396,11 +403,19 @@ class SamplingEngine:
             if len(pending) == 2:
                 out.append(harvest(pending.pop(0)))
         out.extend(harvest(p) for p in pending)
-        return np.concatenate(out, axis=0)[skip:skip + n]
+        result = np.concatenate(out, axis=0)[skip:skip + n]
+        if stages is not None:
+            # the whole chunk loop is "dispatch": device compute plus
+            # the host copies that complete it (the harvest is the
+            # chunk's natural sync point)
+            stages["dispatch"] = (stages.get("dispatch", 0.0)
+                                  + time.perf_counter() - t_dispatch)
+        return result
 
     def sample_frame(self, n: int, seed: int = 0, offset: int = 0,
                      condition: Optional[int] = None,
-                     snap: Optional[EngineSnapshot] = None):
+                     snap: Optional[EngineSnapshot] = None,
+                     stages: Optional[dict] = None):
         """Decoded raw-format DataFrame (categories as strings, dates
         rejoined) — exactly what the one-shot CSV path writes."""
         from fed_tgan_tpu.data.decode import decode_matrix
@@ -408,20 +423,32 @@ class SamplingEngine:
         if snap is None:
             snap = self.snapshot()
         mat = self.sample_decoded(n, seed=seed, offset=offset,
-                                  condition=condition, snap=snap)
-        return decode_matrix(mat, snap.model.meta, snap.model.encoders)
+                                  condition=condition, snap=snap,
+                                  stages=stages)
+        t_decode = time.perf_counter()
+        frame = decode_matrix(mat, snap.model.meta, snap.model.encoders)
+        if stages is not None:
+            stages["decode"] = (stages.get("decode", 0.0)
+                                + time.perf_counter() - t_decode)
+        return frame
 
     def sample_csv_bytes(self, n: int, seed: int = 0, offset: int = 0,
                          condition: Optional[int] = None,
                          header: bool = True,
-                         snap: Optional[EngineSnapshot] = None) -> bytes:
+                         snap: Optional[EngineSnapshot] = None,
+                         stages: Optional[dict] = None) -> bytes:
         """CSV bytes with the same formatting as ``data.csvio.write_csv``
         (the one-shot file), so served output is byte-comparable to it."""
         from fed_tgan_tpu.data.csvio import csv_bytes
 
         frame = self.sample_frame(n, seed=seed, offset=offset,
-                                  condition=condition, snap=snap)
+                                  condition=condition, snap=snap,
+                                  stages=stages)
+        t_ser = time.perf_counter()
         out = csv_bytes(frame)
         if not header:
             out = out.split(b"\n", 1)[1]
+        if stages is not None:
+            stages["serialize"] = (stages.get("serialize", 0.0)
+                                   + time.perf_counter() - t_ser)
         return out

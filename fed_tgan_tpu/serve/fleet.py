@@ -296,6 +296,10 @@ class _FleetRequest:
     result: bytes | None = None
     error: str | None = None
     status: int = 500
+    # request-scoped trace context (see service._Request): stamped by
+    # the worker at pop time, stage seconds accumulate host-side only
+    popped_at: float = 0.0
+    stages: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -346,6 +350,8 @@ class FleetService:
             maxsize=max(1, int(queue_size)))
         self._draining = threading.Event()
         self._last_reload_check = time.monotonic()
+        # first stage summary goes out with the first batch
+        self._last_stage_emit = float("-inf")
         # per-tenant in-flight counts (admission fairness) + shed
         # accumulators for the rate-limited tenant_shed journal events
         self._adm_lock = threading.Lock()
@@ -485,7 +491,9 @@ class FleetService:
                 continue
             if item is _STOP:
                 self._process(self._drain_remaining())
+                self._emit_stages(force=True)
                 return
+            item.popped_at = time.time()
             batch = [item]
             stop = False
             while len(batch) < self.max_batch:
@@ -496,10 +504,12 @@ class FleetService:
                 if nxt is _STOP:
                     stop = True
                     break
+                nxt.popped_at = time.time()
                 batch.append(nxt)
             self._process(batch)
             if stop:
                 self._process(self._drain_remaining())
+                self._emit_stages(force=True)
                 return
             self._maybe_reload()
 
@@ -511,12 +521,17 @@ class FleetService:
             except queue.Empty:
                 return batch
             if req is not _STOP:
+                req.popped_at = time.time()
                 batch.append(req)
 
     def _process(self, batch: list) -> None:
         if not batch:
             return
         self.metrics.record_batch(len(batch))
+        # worker-sampled gauges: what's still queued behind this batch,
+        # and lane occupancy (0 unless a coalesced dispatch fires below)
+        self.metrics.set_queue_depth(self.queue_depth())
+        self.metrics.set_lanes_occupied(0)
         # bind every request to ONE tenant snapshot for the whole batch
         # (reload-under-fire safety), then group single-chunk requests by
         # bucket key: same (steps, conditional, layout-sig) => same
@@ -550,17 +565,41 @@ class FleetService:
             self._run_single(member)
         self.metrics.set_fleet_state(len(self.fleet.names()),
                                      self.fleet.cache.stats())
+        self._emit_stages()
+
+    @staticmethod
+    def _stamp_wait(req: _FleetRequest, t_start: float) -> None:
+        """queue_wait ends at the pop, batch_form when this request's
+        own processing starts (the wait behind earlier batch members
+        lands in batch_form — the stages sum to the server latency)."""
+        popped = req.popped_at or t_start
+        req.stages["queue_wait"] = max(0.0, popped - req.enqueued_at)
+        req.stages["batch_form"] = max(0.0, t_start - popped)
+
+    def _emit_stages(self, force: bool = False) -> None:
+        """Rate-limited per-tenant ``serve_stages`` journal summaries."""
+        now = time.monotonic()
+        if not force and now - self._last_stage_emit < 5.0:
+            return
+        snaps = self.metrics.stage_snapshots()
+        if snaps:
+            self._last_stage_emit = now
+            for tenant, stages in snaps.items():
+                _emit_event("serve_stages", tenant=tenant, stages=stages)
 
     def _run_single(self, m: _Member) -> None:
         req = m.req
+        self._stamp_wait(req, time.time())
         try:
             req.result = m.rt.engine.sample_csv_bytes(
                 req.n, seed=req.seed, offset=req.offset,
                 condition=req.condition, header=req.header, snap=m.snap,
+                stages=req.stages,
             )
             req.status = 200
             self.metrics.record_request(req.tenant,
                                         time.time() - req.enqueued_at, req.n)
+            self.metrics.record_stages(req.tenant, req.stages)
             self._finish(req)
         except Exception as exc:  # noqa: BLE001 — becomes the 500 body
             self._fail(req, 500, repr(exc))
@@ -623,6 +662,10 @@ class FleetService:
         snap0 = members[0].snap
         lanes = min(_pow2(len(members)), self.max_lanes)
         padded = list(members) + [members[0]] * (lanes - len(members))
+        t_start = time.time()
+        for m in members:
+            self._stamp_wait(m.req, t_start)
+        t_dispatch = time.perf_counter()
         try:
             prog = self._lane_program(snap0, steps, conditional, lanes)
             B = snap0.cfg.batch_size
@@ -650,22 +693,33 @@ class FleetService:
             for m in members:
                 self._fail(m.req, 500, repr(exc))
             return
+        # the whole coalesced device round (stack -> program -> host
+        # copy) is each member's "dispatch": they all waited on it
+        dispatch_s = time.perf_counter() - t_dispatch
+        for m in members:
+            m.req.stages["dispatch"] = dispatch_s
         self.metrics.record_lane_dispatch(len(members))
+        self.metrics.set_lanes_occupied(len(members))
         from fed_tgan_tpu.data.csvio import csv_bytes
         from fed_tgan_tpu.data.decode import decode_matrix
 
         for i, m in enumerate(members):
             req = m.req
             try:
+                t_decode = time.perf_counter()
                 mat = host[i, m.skip:m.skip + req.n]
                 frame = decode_matrix(mat, m.snap.model.meta,
                                       m.snap.model.encoders)
+                t_ser = time.perf_counter()
                 out = csv_bytes(frame)
                 if not req.header:
                     out = out.split(b"\n", 1)[1]
+                req.stages["decode"] = t_ser - t_decode
+                req.stages["serialize"] = time.perf_counter() - t_ser
                 req.result, req.status = out, 200
                 self.metrics.record_request(
                     req.tenant, time.time() - req.enqueued_at, req.n)
+                self.metrics.record_stages(req.tenant, req.stages)
                 self._finish(req)
             except Exception as exc:  # noqa: BLE001
                 self._fail(req, 500, repr(exc))

@@ -22,6 +22,14 @@ from typing import Optional
 
 from fed_tgan_tpu.obs.registry import MetricsRegistry
 
+#: request lifecycle stages, in order.  ``queue_wait`` = enqueue ->
+#: popped by the worker; ``batch_form`` = popped -> this request's own
+#: processing starts (absorbs the wait behind earlier batch members, so
+#: the five stages sum to ~the full server-side latency); ``dispatch``
+#: = device program dispatch + host harvest; ``decode`` = inverse
+#: feature transform; ``serialize`` = CSV bytes.
+STAGES = ("queue_wait", "batch_form", "dispatch", "decode", "serialize")
+
 
 def _quantile(sorted_vals: list, q: float) -> float:
     """Nearest-rank quantile on an already-sorted list."""
@@ -29,6 +37,15 @@ def _quantile(sorted_vals: list, q: float) -> float:
         return 0.0
     idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
     return sorted_vals[idx]
+
+
+def _stage_stats(hist) -> dict:
+    vals = hist.reservoir_values()
+    return {
+        "count": int(hist.count),
+        "p50_ms": round(_quantile(vals, 0.50) * 1e3, 2),
+        "p99_ms": round(_quantile(vals, 0.99) * 1e3, 2),
+    }
 
 
 class ServiceMetrics:
@@ -58,6 +75,20 @@ class ServiceMetrics:
         # seconds, enqueue -> response ready
         self._latency = self.registry.histogram(
             "latency_seconds", "request latency (s)", reservoir=reservoir)
+        # the queue-depth gauge the module docstring always advertised:
+        # sampled by the batch worker each cycle, scrape-time fallback
+        # in snapshot() keeps the pre-gauge callers working
+        self._queue_depth = self.registry.gauge(
+            "queue_depth", "requests parked in the admission queue")
+        # per-stage latency attribution (seconds): one labeled series
+        # per lifecycle stage, same exact-quantile reservoir contract
+        # as the end-to-end histogram
+        self._stages = {
+            stage: self.registry.histogram(
+                "stage_seconds", "request stage latency (s)",
+                reservoir=reservoir, labels={"stage": stage})
+            for stage in STAGES
+        }
 
     # ------------------------------------------------- attribute compat
     # pre-registry callers read these as plain ints
@@ -105,7 +136,22 @@ class ServiceMetrics:
     def record_reload(self) -> None:
         self._reloads.inc()
 
+    def record_stages(self, stages: dict) -> None:
+        """Observe one request's per-stage seconds ({stage: s})."""
+        for stage, seconds in stages.items():
+            hist = self._stages.get(stage)
+            if hist is not None:
+                hist.observe(seconds)
+
+    def set_queue_depth(self, depth: int) -> None:
+        self._queue_depth.set(int(depth))
+
     # --------------------------------------------------------- export
+
+    def stage_snapshot(self) -> dict:
+        """{stage: {count, p50_ms, p99_ms}} for stages with data."""
+        return {stage: _stage_stats(hist)
+                for stage, hist in self._stages.items() if hist.count}
 
     def snapshot(self, queue_depth: int = 0) -> dict:
         lat = self._latency.reservoir_values()
@@ -139,6 +185,16 @@ class ServiceMetrics:
             kind = "counter" if key.endswith("_total") else "gauge"
             lines.append(f"# TYPE {prefix}_{key} {kind}")
             lines.append(f"{prefix}_{key} {value}")
+        stages = self.stage_snapshot()
+        if stages:
+            lines.append(f"# TYPE {prefix}_stage_p99_ms gauge")
+            for stage, st in stages.items():
+                lines.append(f'{prefix}_stage_p99_ms{{stage="{stage}"}} '
+                             f"{st['p99_ms']}")
+            lines.append(f"# TYPE {prefix}_stage_p50_ms gauge")
+            for stage, st in stages.items():
+                lines.append(f'{prefix}_stage_p50_ms{{stage="{stage}"}} '
+                             f"{st['p50_ms']}")
         return "\n".join(lines) + "\n"
 
 
@@ -185,6 +241,11 @@ class FleetMetrics:
             "program_cache_evictions_total", "LRU entries evicted")
         self._tenant_gauge = self.registry.gauge(
             "tenants", "tenant models currently hot")
+        self._queue_depth = self.registry.gauge(
+            "queue_depth", "requests parked in the admission queue")
+        self._lanes_occupied = self.registry.gauge(
+            "lanes_occupied",
+            "lanes filled by the most recent coalesced dispatch")
 
     def _bundle(self, tenant: str) -> dict:
         with self._tlock:
@@ -212,6 +273,14 @@ class FleetMetrics:
                         "latency_seconds", "request latency (s)",
                         buckets=self.LATENCY_BUCKETS,
                         reservoir=self.reservoir, labels=lab),
+                    "stages": {
+                        stage: reg.histogram(
+                            "stage_seconds", "request stage latency (s)",
+                            buckets=self.LATENCY_BUCKETS,
+                            reservoir=self.reservoir,
+                            labels={"tenant": tenant, "stage": stage})
+                        for stage in STAGES
+                    },
                 }
                 self._tenants[tenant] = b
             return b
@@ -242,6 +311,20 @@ class FleetMetrics:
     def record_reload(self, tenant: str) -> None:
         self._bundle(tenant)["reloads"].inc()
 
+    def record_stages(self, tenant: str, stages: dict) -> None:
+        """Observe one request's per-stage seconds for ``tenant``."""
+        hists = self._bundle(tenant)["stages"]
+        for stage, seconds in stages.items():
+            hist = hists.get(stage)
+            if hist is not None:
+                hist.observe(seconds)
+
+    def set_queue_depth(self, depth: int) -> None:
+        self._queue_depth.set(int(depth))
+
+    def set_lanes_occupied(self, lanes: int) -> None:
+        self._lanes_occupied.set(int(lanes))
+
     def set_fleet_state(self, n_tenants: int, cache_stats: dict) -> None:
         self._tenant_gauge.set(n_tenants)
         self._cache_entries.set(cache_stats.get("entries", 0))
@@ -252,10 +335,26 @@ class FleetMetrics:
 
     # --------------------------------------------------------- export
 
+    def stage_snapshots(self) -> dict:
+        """{tenant: {stage: {count, p50_ms, p99_ms}}}, tenants with data."""
+        with self._tlock:
+            bundles = dict(self._tenants)
+        out = {}
+        for tenant, b in sorted(bundles.items()):
+            stages = {stage: _stage_stats(hist)
+                      for stage, hist in b["stages"].items() if hist.count}
+            if stages:
+                out[tenant] = stages
+        return out
+
     def tenant_snapshot(self, tenant: str) -> dict:
         b = self._bundle(tenant)
         lat = b["latency"].reservoir_values()
+        stages = {stage: _stage_stats(hist)
+                  for stage, hist in b["stages"].items() if hist.count}
+        extra = {"stages": stages} if stages else {}
         return {
+            **extra,
             "requests_total": int(b["requests"].value),
             "rows_total": int(b["rows"].value),
             "errors_total": int(b["errors"].value),
@@ -282,6 +381,7 @@ class FleetMetrics:
             "lane_dispatches_total": int(self._lane_dispatches.value),
             "lane_requests_total": int(self._lane_requests.value),
             "queue_depth": queue_depth,
+            "lanes_occupied": int(self._lanes_occupied.value),
             "batch_occupancy": round(requests / batches, 3)
             if batches else 0.0,
             "rows_per_sec": round(rows / uptime, 1),

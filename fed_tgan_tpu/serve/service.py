@@ -54,6 +54,12 @@ class _Request:
     result: bytes | None = None
     error: str | None = None
     status: int = 500
+    # request-scoped trace context: the worker stamps popped_at when it
+    # pulls the request off the queue, and per-stage seconds accumulate
+    # in stages (queue_wait/batch_form here, dispatch/decode/serialize
+    # inside the engine) — host clocks only, never a device sync
+    popped_at: float = 0.0
+    stages: dict = field(default_factory=dict)
 
 
 class SamplingService:
@@ -74,6 +80,8 @@ class SamplingService:
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(queue_size)))
         self._draining = threading.Event()
         self._last_reload_check = time.monotonic()
+        # first stage summary goes out with the first batch
+        self._last_stage_emit = float("-inf")
         self._httpd: ThreadingHTTPServer | None = None
         self._worker_thread: threading.Thread | None = None
         self._serve_thread: threading.Thread | None = None
@@ -156,7 +164,9 @@ class SamplingService:
                 continue
             if item is _STOP:
                 self._process(self._drain_remaining())
+                self._emit_stages(force=True)
                 return
+            item.popped_at = time.time()
             batch = [item]
             stop = False
             while len(batch) < self.max_batch:
@@ -167,10 +177,12 @@ class SamplingService:
                 if nxt is _STOP:
                     stop = True
                     break
+                nxt.popped_at = time.time()
                 batch.append(nxt)
             self._process(batch)
             if stop:
                 self._process(self._drain_remaining())
+                self._emit_stages(force=True)
                 return
             self._maybe_reload()
 
@@ -182,30 +194,55 @@ class SamplingService:
             except queue.Empty:
                 return batch
             if req is not _STOP:
+                req.popped_at = time.time()
                 batch.append(req)
 
     def _process(self, batch: list) -> None:
         if not batch:
             return
         self.metrics.record_batch(len(batch))
+        # the advertised queue-depth gauge: sampled once per worker
+        # cycle, right after the batch formed (what's still waiting)
+        self.metrics.set_queue_depth(self.queue_depth())
         # one snapshot for the whole formed batch: a hot reload adopting a
         # new model mid-batch must never swap the model out from under
         # requests already grouped against the old one
         snap = self.engine.snapshot()
         for req in batch:
+            # queue_wait ends at the pop; batch_form ends when THIS
+            # request's own processing starts, so the wait behind
+            # earlier batch members lands in batch_form and the five
+            # stages sum to ~the full server-side latency
+            t_start = time.time()
+            popped = req.popped_at or t_start
+            req.stages["queue_wait"] = max(0.0, popped - req.enqueued_at)
+            req.stages["batch_form"] = max(0.0, t_start - popped)
             try:
                 req.result = self.engine.sample_csv_bytes(
                     req.n, seed=req.seed, offset=req.offset,
                     condition=req.condition, header=req.header, snap=snap,
+                    stages=req.stages,
                 )
                 req.status = 200
                 self.metrics.record_request(
                     time.time() - req.enqueued_at, req.n)
+                self.metrics.record_stages(req.stages)
             except Exception as exc:  # noqa: BLE001 — becomes the 500 body
                 req.error, req.status = repr(exc), 500
                 self.metrics.record_error()
             finally:
                 req.done.set()
+        self._emit_stages()
+
+    def _emit_stages(self, force: bool = False) -> None:
+        """Rate-limited ``serve_stages`` journal summary (~1 per 5 s)."""
+        now = time.monotonic()
+        if not force and now - self._last_stage_emit < 5.0:
+            return
+        stages = self.metrics.stage_snapshot()
+        if stages:
+            self._last_stage_emit = now
+            _emit_event("serve_stages", stages=stages)
 
     def _maybe_reload(self) -> None:
         if self.reload_interval_s <= 0:
@@ -263,6 +300,7 @@ def _make_handler(service: SamplingService):
                     "model_id": model.model_id,
                     "model_name": model.artifact.name,
                     **snap,
+                    "stages": service.metrics.stage_snapshot(),
                 })
             elif parsed.path == "/metrics":
                 text = service.metrics.render_prometheus(

@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fed_tgan_tpu.analysis.sanitizers import hot_region
-from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.journal import emit as _emit_event, get_journal
 from fed_tgan_tpu.obs.registry import counter as _metric_counter
 from fed_tgan_tpu.obs.trace import span as _span
 from fed_tgan_tpu.federation.init import FederatedInit, renormalize_weights
@@ -655,8 +655,16 @@ class FederatedTrainer(RoundBookkeeping):
 
         self.spec = SegmentSpec.from_output_info(init.output_info)
 
-        (self.cond_stack, self.rows_stack, self.data_stack, self.steps,
-         self.server_cond) = build_client_stacks(init, self.cfg, self.spec)
+        # shard packing is the last onboarding phase before training --
+        # spanned + journaled so `obs report` shows the full init wall
+        t_pack = time.perf_counter()
+        with _span("init.shard_packing", clients=n_clients):
+            (self.cond_stack, self.rows_stack, self.data_stack, self.steps,
+             self.server_cond) = build_client_stacks(init, self.cfg,
+                                                     self.spec)
+        _emit_event("init_phase", phase="shard_packing",
+                    seconds=round(time.perf_counter() - t_pack, 6),
+                    clients=n_clients)
         self.max_steps = int(self.steps.max())
         self.weights = np.asarray(init.weights, dtype=np.float32)
         if (self.cfg.precision == "bf16"
@@ -698,6 +706,7 @@ class FederatedTrainer(RoundBookkeeping):
         self._ema_updates = 0  # rounds folded into self.ema (debias power)
 
         self._epoch_fns: dict[int, Any] = {}
+        self._costed_epochs: set = set()  # epoch-fn keys already ledgered
         self._device_stacks = None  # uploaded once on first fit()
         from fed_tgan_tpu.ops.decode import select_snapshot_decode
 
@@ -736,6 +745,32 @@ class FederatedTrainer(RoundBookkeeping):
                 psum_groups=self._psum_groups, straggle=straggle,
             )
         return self._epoch_fns[key]
+
+    def _ledger_epoch_cost(self, fn, rounds: int, args: list) -> None:
+        """Journal-gated program-cost recording for the epoch program.
+
+        When a journal is installed, the first dispatch of each distinct
+        epoch program additionally lowers it (AOT, no compile -- the
+        dispatch right after pays the real compile exactly once either
+        way) and records flops/bytes into the process cost ledger plus a
+        ``program_cost`` journal event.  Free when no journal is
+        installed; never raises into training."""
+        if get_journal() is None or not hasattr(fn, "lower"):
+            return
+        key = (rounds, id(fn))
+        if key in self._costed_epochs:
+            return
+        self._costed_epochs.add(key)
+        try:
+            from fed_tgan_tpu.obs.ledger import entry_from_lowered, get_ledger
+
+            entry = entry_from_lowered(
+                f"train_epoch[r{rounds}@{self.cfg.precision}]",
+                fn.lower(*args), family="train_live", do_compile=False)
+            get_ledger().record(entry)
+            _emit_event("program_cost", **entry.to_dict())
+        except Exception:  # noqa: BLE001 -- obs must never kill training
+            pass
 
     def drop_client(self, idx: int, reason: str = "") -> None:
         """Drop client ``idx`` (0-based) from all future rounds.
@@ -962,11 +997,12 @@ class FederatedTrainer(RoundBookkeeping):
             args = [models, data, cond, rows, steps, weights_call, self._key]
             if use_ema:
                 args.append(self.ema)
+            epoch_fn = self._epoch_fn_for(size, update_fault, straggle_idx)
+            self._ledger_epoch_cost(epoch_fn, size, args)
             with _span("train.local_steps", rounds=size,
                        rounds_per_program=size), \
                     hot_region(region):
-                outs = self._epoch_fn_for(
-                    size, update_fault, straggle_idx)(*args)
+                outs = epoch_fn(*args)
             models, metrics, self._key, finite = outs[:4]
             rest = list(outs[4:])
             sdelta = rest.pop(0) if straggle_idx is not None else None

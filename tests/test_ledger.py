@@ -1,0 +1,298 @@
+"""Device cost ledger, request-stage attribution, and the SLO gate.
+
+Three layers, cheapest first: the pure-stdlib SLO checker (tmp budget
+files, no jax), the ledger over real lowered programs (8-device virtual
+mesh, same harness the contract tests use), and the per-stage latency
+attribution end-to-end through the in-process HTTP service (one demo
+artifact per module, like test_serve.py).
+"""
+
+import argparse
+import json
+import os
+import urllib.request
+
+import pytest
+
+from fed_tgan_tpu.obs.ledger import CostEntry, CostLedger
+from fed_tgan_tpu.obs.slo import (
+    SLOError,
+    check_slo,
+    default_budgets_path,
+    journal_figures,
+    slo_main,
+)
+
+pytestmark = pytest.mark.obs
+
+_silent = lambda *a, **k: None  # noqa: E731
+
+
+# ------------------------------------------------------------- SLO gate
+
+
+def _write(path, doc):
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return str(path)
+
+
+def _budgets(path, rules):
+    return _write(path, {"schema": 1, "budgets": rules})
+
+
+def test_slo_pass_on_healthy_record(tmp_path):
+    rec = _write(tmp_path / "rec.json",
+                 {"metric": "bench_serving(test)(cpu)", "value": 50000,
+                  "p99_ms": 20.0})
+    bud = _budgets(tmp_path / "b.json", [
+        {"name": "rows-floor", "select": {"metric_prefix": "bench_serving("},
+         "metric": "value", "min": 30000},
+        {"name": "p99", "metric": "p99_ms", "max": 35.0},
+    ])
+    code, lines = check_slo(rec, bud)
+    assert code == 0
+    assert "slo: 2 checked, 0 regressions, 0 stale budgets" in lines[-1]
+
+
+def test_slo_regression_exits_1(tmp_path):
+    rec = _write(tmp_path / "rec.json",
+                 {"metric": "bench_serving(test)(cpu)", "p99_ms": 80.0})
+    bud = _budgets(tmp_path / "b.json",
+                   [{"name": "p99", "metric": "p99_ms", "max": 35.0}])
+    code, lines = check_slo(rec, bud)
+    assert code == 1
+    assert any(line.startswith("REGRESSION p99") for line in lines)
+
+
+def test_slo_improvement_exits_0_with_stale_warning(tmp_path):
+    rec = _write(tmp_path / "rec.json",
+                 {"metric": "bench_serving(test)(cpu)", "p99_ms": 2.0})
+    bud = _budgets(tmp_path / "b.json",
+                   [{"name": "p99", "metric": "p99_ms", "max": 35.0}])
+    code, lines = check_slo(rec, bud)
+    assert code == 0
+    assert any("stale budget p99" in line for line in lines)
+
+
+def test_slo_malformed_budgets_exits_2(tmp_path, capsys):
+    rec = _write(tmp_path / "rec.json", {"metric": "x", "p99_ms": 1.0})
+    bad = _write(tmp_path / "bad.json", {"not_budgets": []})
+    with pytest.raises(SLOError):
+        check_slo(rec, bad)
+    ns = argparse.Namespace(input=rec, budgets=bad)
+    assert slo_main(ns) == 2
+    assert "slo:" in capsys.readouterr().out
+
+
+def test_slo_malformed_input_exits_2(tmp_path):
+    bad = _write(tmp_path / "notes.json", {"no": "metric here"})
+    with pytest.raises(SLOError):
+        check_slo(bad, default_budgets_path())
+
+
+def test_slo_journal_figures_fold_and_gate(tmp_path):
+    """program_cost last-wins, serve_stages worst-window max, init_phase
+    sums -- and the folded figures drive the same two-sided policy."""
+    events = [
+        {"type": "program_cost", "name": "fused_epoch[weighted]",
+         "flops": 100.0, "peak_bytes": 10},
+        {"type": "program_cost", "name": "fused_epoch[weighted]",
+         "flops": 120.0, "peak_bytes": 12},
+        {"type": "serve_stages",
+         "stages": {"dispatch": {"count": 3, "p50_ms": 1.0, "p99_ms": 4.0}}},
+        {"type": "serve_stages",
+         "stages": {"dispatch": {"count": 5, "p50_ms": 2.0, "p99_ms": 9.0}}},
+        {"type": "init_phase", "phase": "local_bgm_fit", "seconds": 2.5},
+        {"type": "init_phase", "phase": "local_bgm_fit", "seconds": 1.5},
+    ]
+    figs = journal_figures(events)
+    assert figs["program/fused_epoch[weighted]/flops"] == 120.0
+    assert figs["stage/dispatch/p99_ms"] == 9.0
+    assert figs["init/local_bgm_fit/seconds"] == 4.0
+
+    jpath = tmp_path / "journal.jsonl"
+    with open(jpath, "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+    bud = _budgets(tmp_path / "b.json", [
+        {"name": "dispatch-p99", "metric": "stage/dispatch/p99_ms",
+         "max": 5.0, "stale_frac": 0.0},
+        {"name": "epoch-flops", "metric": "program/fused_epoch[weighted]/flops",
+         "max": 500.0, "stale_frac": 0.0},
+    ])
+    code, lines = check_slo(str(jpath), bud)
+    assert code == 1  # 9.0 ms > 5.0 ms budget
+    assert any("REGRESSION dispatch-p99" in line for line in lines)
+
+
+def test_slo_accepts_checked_in_bench_records():
+    """The packaged budgets must describe the repo's own artifacts --
+    zero regressions AND zero stale warnings on the seeded records."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rec in ("BENCH_r09.json", "BENCH_r10.json"):
+        path = os.path.join(root, rec)
+        if not os.path.exists(path):
+            pytest.skip(f"{rec} not on disk")
+        code, lines = check_slo(path, default_budgets_path())
+        assert code == 0, lines
+        assert "0 regressions, 0 stale budgets" in lines[-1], lines
+
+
+# ------------------------------------------------------------ ledger core
+
+
+def test_ledger_note_compile_then_record_merges():
+    led = CostLedger()
+    led.note_compile("prog")
+    led.note_compile("prog")
+    assert led.entries()["prog"].compiles == 2
+    led.record(CostEntry(name="prog", flops=42.0))
+    merged = led.entries()["prog"]
+    assert merged.flops == 42.0 and merged.compiles == 2
+    assert led.snapshot()["prog"]["flops"] == 42.0
+
+
+def _require_mesh_or_skip():
+    from fed_tgan_tpu.analysis.contracts.harness import (
+        HarnessError,
+        require_mesh,
+    )
+    try:
+        require_mesh()
+    except HarnessError as exc:
+        pytest.skip(f"lowering unavailable: {exc}")
+
+
+def test_contract_ledger_nonzero_for_epoch_and_serve_bucket():
+    """The acceptance core: real lowered programs -- the weighted fused
+    epoch and a serve bucket -- carry nonzero flops, bytes accessed, and
+    peak bytes through the full lower+compile+analysis path."""
+    pytest.importorskip("jax")
+    _require_mesh_or_skip()
+    from fed_tgan_tpu.analysis.contracts.harness import ENTRYPOINT_FAMILIES
+    from fed_tgan_tpu.obs.ledger import contract_cost_ledger
+
+    serve_name = sorted(ENTRYPOINT_FAMILIES["serve_engine"])[0]
+    fams = {
+        "train_federated": {
+            "fused_epoch[weighted]":
+            ENTRYPOINT_FAMILIES["train_federated"]["fused_epoch[weighted]"],
+        },
+        "serve_engine": {
+            serve_name: ENTRYPOINT_FAMILIES["serve_engine"][serve_name],
+        },
+    }
+    led = CostLedger()
+    entries = contract_cost_ledger(families=fams, ledger=led, journal=False)
+    assert set(entries) == {"fused_epoch[weighted]", serve_name}
+    for name, e in entries.items():
+        assert e.flops > 0, name
+        assert e.bytes_accessed > 0, name
+        assert e.peak_bytes > 0, name
+    assert led.entries()["fused_epoch[weighted]"].family == "train_federated"
+    assert led.entries()[serve_name].family == "serve_engine"
+
+
+def test_contract_ledger_journals_program_cost(tmp_path):
+    pytest.importorskip("jax")
+    _require_mesh_or_skip()
+    from fed_tgan_tpu.analysis.contracts.harness import ENTRYPOINT_FAMILIES
+    from fed_tgan_tpu.obs.journal import RunJournal, read_journal, set_journal
+    from fed_tgan_tpu.obs.ledger import contract_cost_ledger
+
+    fams = {"train_federated": {
+        "fused_epoch[weighted]":
+        ENTRYPOINT_FAMILIES["train_federated"]["fused_epoch[weighted]"],
+    }}
+    jpath = os.path.join(str(tmp_path), "journal.jsonl")
+    journal = RunJournal(jpath, run_id="test_ledger")
+    set_journal(journal)
+    try:
+        contract_cost_ledger(families=fams, ledger=CostLedger())
+    finally:
+        set_journal(None)
+        journal.close()
+    costs = [e for e in read_journal(jpath) if e["type"] == "program_cost"]
+    assert len(costs) == 1
+    assert costs[0]["name"] == "fused_epoch[weighted]"
+    assert costs[0]["flops"] > 0 and costs[0]["peak_bytes"] > 0
+
+
+# ------------------------------------------- stage attribution end-to-end
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(tmp_path_factory):
+    from fed_tgan_tpu.serve.demo import build_demo_artifact
+
+    return build_demo_artifact(str(tmp_path_factory.mktemp("ledger_art")))
+
+
+@pytest.fixture(scope="module")
+def service(artifact_dir):
+    from fed_tgan_tpu.serve.registry import ModelRegistry
+    from fed_tgan_tpu.serve.service import SamplingService
+
+    svc = SamplingService(
+        ModelRegistry(artifact_dir, log=_silent),
+        port=0, max_batch=4, queue_size=32, log=_silent,
+    ).start()
+    yield svc
+    svc.shutdown(drain=False)
+
+
+def _get(url, timeout=120):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+@pytest.mark.serve
+def test_service_stage_attribution_end_to_end(service):
+    """Every request populates all five lifecycle stages, and the stages
+    account for >= 90% of the recorded end-to-end latency (the issue's
+    attribution-coverage acceptance bar).  Means, not quantiles: each
+    request's stages sum to ~its server-side latency, so sum-of-stage-
+    means vs mean latency is the per-request coverage, averaged."""
+    from fed_tgan_tpu.serve.metrics import STAGES
+
+    for seed in range(8):
+        assert _get(f"{service.url}/sample?rows=30&seed={seed}")
+    snap = service.metrics.stage_snapshot()
+    assert set(snap) == set(STAGES)
+    assert all(st["count"] >= 8 for st in snap.values())
+
+    lat = service.metrics._latency.reservoir_values()
+    mean_latency = sum(lat) / len(lat)
+    stage_mean_sum = sum(
+        sum(h.reservoir_values()) / h.count
+        for h in service.metrics._stages.values() if h.count)
+    assert stage_mean_sum >= 0.9 * mean_latency
+
+    # the stages surface everywhere the issue says they should
+    health = json.loads(_get(f"{service.url}/healthz"))
+    assert set(health["stages"]) == set(STAGES)
+    prom = _get(f"{service.url}/metrics").decode()
+    assert 'stage_p99_ms{stage="dispatch"}' in prom
+
+
+@pytest.mark.serve
+@pytest.mark.sanitize
+def test_stage_timing_is_transfer_free(artifact_dir):
+    """Stage instrumentation uses host clocks only: a guarded hot-region
+    pass (second entry arms the d2h transfer guard) with a stages dict
+    must complete without tripping the sanitizer."""
+    from fed_tgan_tpu.analysis.sanitizers import sanitize
+    from fed_tgan_tpu.serve.engine import SamplingEngine
+    from fed_tgan_tpu.serve.registry import load_model, resolve_artifact
+
+    model = load_model(resolve_artifact(artifact_dir, log=_silent))
+    B = model.synth.cfg.batch_size
+    with sanitize():
+        eng = SamplingEngine(model)
+        eng.sample_csv_bytes(B, seed=1)  # warmup: compiles, region entry 1
+        stages = {}
+        out = eng.sample_csv_bytes(B, seed=2, stages=stages)  # guarded
+    assert out
+    assert set(stages) == {"dispatch", "decode", "serialize"}
+    assert all(v >= 0.0 for v in stages.values())
