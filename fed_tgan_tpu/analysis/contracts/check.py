@@ -394,6 +394,7 @@ _FAMILY_DIRS = {
     "fused_rounds": ("train", "parallel", "ops", "models"),
     "cohort_rounds": ("train", "parallel", "ops", "models"),
     "parallel_fedavg": ("parallel",),
+    "ingest": ("features", "federation"),
     "serve_engine": ("serve", "ops", "models"),
 }
 

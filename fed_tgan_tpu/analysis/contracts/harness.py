@@ -419,6 +419,45 @@ def _lower_serve_lanes(n_steps: int, conditional: bool, lanes: int = 2,
     return jax.jit(lane_run, donate_argnums=7).lower(*lane_args)
 
 
+def _lower_ingest_fit(batch: int, rows: int):
+    """The cohort-batched BGM fit exactly as ``_fit_flat`` dispatches it:
+    the process-wide jitted vmap-over-columns program at production
+    hyperparameters (N_CLUSTERS=10, 100 sweeps), on one pow2 shape bucket
+    ``(batch, rows)`` where batch spans clients x columns.  Shapes here
+    are two buckets a real onboarding run actually hits (small cohort and
+    packed chunk)."""
+    import jax.numpy as jnp
+
+    from fed_tgan_tpu.features.bgm_jax import _jitted_fit
+
+    require_mesh()
+    fit = _jitted_fit(10, 100, 1e-6, 0.001)
+    xs = jnp.zeros((batch, rows), jnp.float32)
+    mask = jnp.ones((batch, rows), jnp.float32)
+    return fit.lower(xs, mask)
+
+
+def _lower_ingest_wd(n_clients: int):
+    """The similarity-sketch W1 program: per-client GMM CDFs vs the pooled
+    mixture on a shared (C, G) grid, one device program over the whole
+    population.  Lowered at two population sizes; the
+    ``collective_bytes_independent`` requirement below pins that the
+    program stays collective-free (single-device data parallel over N) as
+    the population grows."""
+    import jax.numpy as jnp
+
+    from fed_tgan_tpu.federation.sketch import GRID_POINTS, _wd_fn
+
+    require_mesh()
+    c, k = 2, 10
+    means = jnp.zeros((n_clients, c, k), jnp.float32)
+    stds = jnp.ones((n_clients, c, k), jnp.float32)
+    weights = jnp.full((n_clients, c, k), 1.0 / k, jnp.float32)
+    omega = jnp.full((n_clients,), 1.0 / n_clients, jnp.float32)
+    grid = jnp.zeros((c, GRID_POINTS), jnp.float32)
+    return _wd_fn().lower(means, stds, weights, omega, grid)
+
+
 #: family -> {program name -> zero-arg builder returning a Lowered}.
 #: Contract JSON files are named after the family keys.
 ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
@@ -445,6 +484,12 @@ ENTRYPOINT_FAMILIES: Dict[str, Dict[str, Callable]] = {
         **{f"robust_agg[{a}@bf16]":
            (lambda a=a: _lower_robust(a, payload_bf16=True))
            for a in ("weighted", "clipped", "trimmed", "median")},
+    },
+    "ingest": {
+        **{f"ingest_fit[b{b}xr{r}]": (lambda b=b, r=r: _lower_ingest_fit(b, r))
+           for b, r in ((8, 128), (64, 128))},
+        **{f"ingest_wd[n{n}]": (lambda n=n: _lower_ingest_wd(n))
+           for n in (8, 64)},
     },
     "serve_engine": {
         **{serve_bucket_name(n, c): (lambda n=n, c=c: _lower_serve(n, c))
@@ -533,6 +578,17 @@ PROGRAM_REQUIREMENTS: Dict[str, Dict[str, dict]] = {
                 # at bf16 (measured 0.58)
                 "ratio": 0.85 if a in ("weighted", "clipped") else 0.65},
            } for a in ("weighted", "clipped", "trimmed", "median")},
+    },
+    "ingest": {
+        # the onboarding programs are single-device batch dispatches: any
+        # collective appearing (or growing with the population) means the
+        # ingest path started shipping per-client traffic again
+        "ingest_fit[b64xr128]": {
+            "collective_bytes_independent": {"vs": "ingest_fit[b8xr128]"},
+        },
+        "ingest_wd[n64]": {
+            "collective_bytes_independent": {"vs": "ingest_wd[n8]"},
+        },
     },
     "serve_engine": {
         # donation_required: every serve bucket writes into a DONATED

@@ -380,6 +380,107 @@ def check_scan_rounds(timeout: int = 300) -> bool:
                  "to 2 sequential dispatches")
 
 
+def check_onboarding(timeout: int = 300) -> bool:
+    """Cohort-batched onboarding holds its three load-bearing properties.
+
+    A subprocess (init owns backend bring-up) runs a small population
+    through ``federated_initialize`` and asserts:
+
+    - **batched-fit parity**: the cohort-batched fit (``batch_fit=True``,
+      one shape-bucketed dispatch for the whole population) produces
+      bit-identical client matrices to the per-client dispatch path —
+      vmap semantics, same pow2-padded program;
+    - **cache round-trip**: a warm re-run against the same ``InitCache``
+      directory restores bit-identical matrices and weights (content-
+      hashed entries; a hit IS the same computation);
+    - **schema invalidation**: mutating a shard's data or schema changes
+      its content fingerprint, so the stale entry can never be looked up
+      — invalidation by construction, no TTLs to misconfigure."""
+    import json
+    import subprocess
+
+    code = (
+        "import json, tempfile\n"
+        "import numpy as np\n"
+        "import pandas as pd\n"
+        "from fed_tgan_tpu.data.ingest import TablePreprocessor\n"
+        "from fed_tgan_tpu.federation.init import federated_initialize\n"
+        "from fed_tgan_tpu.federation.init_cache import (\n"
+        "    InitCache, shard_fingerprint)\n"
+        "def mk(seed, shift=0.0):\n"
+        "    r = np.random.default_rng(seed)\n"
+        "    return TablePreprocessor(frame=pd.DataFrame({\n"
+        "        'a': r.normal(size=96) + shift,\n"
+        "        'b': r.normal(2.0, 0.5, size=96),\n"
+        "        'c': r.choice(['x', 'y', 'z'], size=96)}),\n"
+        "        name='DoctorOnboard', categorical_columns=['c'])\n"
+        "clients = [mk(i) for i in range(6)]\n"
+        "seq = federated_initialize(clients, seed=0, backend='jax',\n"
+        "                           batch_fit=False)\n"
+        "bat = federated_initialize(clients, seed=0, backend='jax',\n"
+        "                           batch_fit=True)\n"
+        "out = {}\n"
+        "out['batched_parity'] = bool(\n"
+        "    all(np.array_equal(a, b) for a, b in\n"
+        "        zip(seq.client_matrices, bat.client_matrices))\n"
+        "    and np.allclose(seq.weights, bat.weights, atol=1e-9))\n"
+        "with tempfile.TemporaryDirectory() as d:\n"
+        "    cache = InitCache(d)\n"
+        "    cold = federated_initialize(clients, seed=0, backend='jax',\n"
+        "                                cache=cache)\n"
+        "    warm = federated_initialize(clients, seed=0, backend='jax',\n"
+        "                                cache=cache)\n"
+        "    out['warm_bit_identical'] = bool(\n"
+        "        all(np.array_equal(a, b) for a, b in\n"
+        "            zip(cold.client_matrices, warm.client_matrices))\n"
+        "        and np.array_equal(cold.weights, warm.weights))\n"
+        "    fp = lambda c: shard_fingerprint(c, n_components=10,\n"
+        "                                     backend='jax', seed=0)\n"
+        "    fp0 = fp(clients[0])\n"
+        "    fp_data = fp(mk(0, shift=1.0))\n"
+        "    alt = TablePreprocessor(frame=clients[0].frame,\n"
+        "        name='DoctorOnboard', categorical_columns=[])\n"
+        "    out['schema_invalidation'] = bool(\n"
+        "        fp_data != fp0 and fp(alt) != fp0\n"
+        "        and cache.load_client(fp0) is not None\n"
+        "        and cache.load_client(fp_data) is None)\n"
+        "print(json.dumps(out))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "onboarding", f"timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return _line(False, "onboarding",
+                     " | ".join(tail) or "onboarding run failed")
+    try:
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        return _line(False, "onboarding", f"unparseable result: {exc!r}")
+    if not res.get("batched_parity"):
+        return _line(False, "onboarding",
+                     "cohort-batched fit is NOT bit-identical to the "
+                     "per-client dispatch path")
+    if not res.get("warm_bit_identical"):
+        return _line(False, "onboarding",
+                     "warm cache restore is NOT bit-identical to the "
+                     "cold fit (stale or lossy cache entries)")
+    if not res.get("schema_invalidation"):
+        return _line(False, "onboarding",
+                     "shard fingerprint did not move under a data/schema "
+                     "change — stale cache entries would be served")
+    return _line(True, "onboarding",
+                 "batched fit bit-identical to per-client path; warm "
+                 "cache restore bit-identical; data/schema changes "
+                 "invalidate by fingerprint")
+
+
 def check_cohort_scale(timeout: int = 300) -> bool:
     """Cohort-sampled partial participation holds its two load-bearing
     properties.
@@ -915,6 +1016,7 @@ def main(argv=None) -> int:
         check_precision(),
         check_scan_rounds(),
         check_cohort_scale(),
+        check_onboarding(),
         check_observability(),
         check_cost_ledger(),
         check_serving(),

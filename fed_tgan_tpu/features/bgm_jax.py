@@ -27,6 +27,7 @@ Differences from sklearn (documented, intentional):
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import numpy as np
@@ -34,6 +35,21 @@ import numpy as np
 from fed_tgan_tpu.obs.trace import span as _span
 
 N_KMEANS_ITERS = 20
+
+# shape-bucketing knobs for the batched fit: rows pad up to a power of two
+# (results are padding-independent — masking — so clients of slightly
+# different shard sizes share one compiled program), and one dispatch is
+# capped so the padded (batch, rows) f32 block stays under ~128 MiB
+_ROWS_FLOOR = 64
+_BATCH_FLOOR = 8
+_MAX_BATCH_ELEMENTS = 1 << 25
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
 
 
 def _fit_batch(x, mask, *, n_components, max_iter, reg_covar, wc_prior):
@@ -132,48 +148,19 @@ def _fit_batch(x, mask, *, n_components, max_iter, reg_covar, wc_prior):
     return means, jnp.sqrt(cov), weights, mean_prec, dof, a, b
 
 
-def fit_columns_jax(
-    columns: "list[np.ndarray]",
-    n_components: int = 10,
-    eps: float = 0.005,
-    max_iter: int = 100,
-    reg_covar: float = 1e-6,
-    wc_prior: float = 0.001,
-):
-    """Fit every column in one jitted, vmapped program; returns ColumnGMMs."""
+@functools.lru_cache(maxsize=None)
+def _jitted_fit(n_components, max_iter, reg_covar, wc_prior):
+    """Process-wide jitted fit, one per hyperparameter tuple.
+
+    Building ``jax.jit(...)`` inside every call hands jax a fresh callable
+    each time, so nothing ever hits the C++ program cache — every client's
+    fit retraced AND recompiled (~1 s/client, the superlinear init wall).
+    Cached here, jax keys compiled programs on input *shape*, and the pow2
+    bucketing below keeps distinct shapes to a handful per run.
+    """
     import jax
-    import jax.numpy as jnp
 
-    from fed_tgan_tpu.features.bgm import ColumnGMM
-
-    cols = [np.asarray(c, dtype=np.float32).reshape(-1) for c in columns]
-    if not cols:
-        return []
-    # degenerate shards (< n_components samples) need the component clamp;
-    # route those through the host fitter rather than slicing a K=10 fit
-    small = {i for i, c in enumerate(cols) if len(c) < n_components}
-    if small:
-        from fed_tgan_tpu.features.bgm import fit_column_gmm
-
-        out = [None] * len(cols)
-        for i in small:
-            out[i] = fit_column_gmm(cols[i], n_components, eps)
-        rest = [i for i in range(len(cols)) if i not in small]
-        fitted = fit_columns_jax(
-            [cols[i] for i in rest], n_components, eps, max_iter, reg_covar,
-            wc_prior,
-        )
-        for i, g in zip(rest, fitted):
-            out[i] = g
-        return out
-    n_max = max(len(c) for c in cols)
-    xs = np.zeros((len(cols), n_max), dtype=np.float32)
-    masks = np.zeros((len(cols), n_max), dtype=np.float32)
-    for i, c in enumerate(cols):
-        xs[i, : len(c)] = c
-        masks[i, : len(c)] = 1.0
-
-    fit = jax.jit(
+    return jax.jit(
         jax.vmap(
             partial(
                 _fit_batch,
@@ -184,28 +171,120 @@ def fit_columns_jax(
             )
         )
     )
-    # one batched transfer for all seven result arrays (jaxlint J01),
-    # then the float64 view is a host-side dtype conversion
-    with _span("init.bgm_fit_jax", columns=len(cols), n_max=n_max):
-        means, stds, weights, mean_prec, dof, stick_a, stick_b = (
-            np.asarray(r, dtype=np.float64)
-            for r in jax.device_get(fit(jnp.asarray(xs), jnp.asarray(masks)))
-        )
-    out = []
-    for i in range(len(cols)):
-        w = weights[i]
-        out.append(
-            ColumnGMM(
-                means=means[i],
-                stds=np.maximum(stds[i], 1e-9),
-                weights=w,
-                active=w > eps,
-                # posterior extras: predict_proba then evaluates the exact
-                # variational E-step instead of the Gaussian approximation
-                mean_precision=mean_prec[i],
-                dof=dof[i],
-                stick_a=stick_a[i],
-                stick_b=stick_b[i],
+
+
+def _fit_flat(cols, n_components, eps, max_iter, reg_covar, wc_prior):
+    """Fit a flat list of f32 columns with shape-bucketed batched dispatches.
+
+    Rows pad to the next power of two (masking makes results independent of
+    padding), the batch axis pads to a power of two with fully-masked dummy
+    columns (``_fit_batch`` clamps ``n_valid`` to 1, so they are numerically
+    inert and simply dropped), and oversized buckets split into chunks so a
+    million-column flat batch still fits device memory.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fed_tgan_tpu.features.bgm import ColumnGMM
+
+    out = [None] * len(cols)
+    # degenerate shards (< n_components samples) need the component clamp;
+    # route those through the host fitter rather than slicing a K=10 fit
+    small = [i for i, c in enumerate(cols) if len(c) < n_components]
+    if small:
+        from fed_tgan_tpu.features.bgm import fit_column_gmm
+
+        for i in small:
+            out[i] = fit_column_gmm(cols[i], n_components, eps)
+
+    buckets: dict[int, list[int]] = {}
+    for i, c in enumerate(cols):
+        if out[i] is None:
+            buckets.setdefault(_pow2_at_least(len(c), _ROWS_FLOOR), []).append(i)
+
+    fit = _jitted_fit(n_components, max_iter, reg_covar, wc_prior)
+    for rows, idxs in sorted(buckets.items()):
+        max_chunk = max(_BATCH_FLOOR, _MAX_BATCH_ELEMENTS // rows)
+        for lo in range(0, len(idxs), max_chunk):
+            chunk = idxs[lo : lo + max_chunk]
+            padded_b = min(_pow2_at_least(len(chunk), _BATCH_FLOOR), max_chunk)
+            xs = np.zeros((padded_b, rows), dtype=np.float32)
+            masks = np.zeros((padded_b, rows), dtype=np.float32)
+            for row, i in enumerate(chunk):
+                c = cols[i]
+                xs[row, : len(c)] = c
+                masks[row, : len(c)] = 1.0
+            # one batched transfer for all seven result arrays (jaxlint
+            # J01), then the float64 view is a host-side dtype conversion
+            means, stds, weights, mean_prec, dof, stick_a, stick_b = (
+                np.asarray(r, dtype=np.float64)
+                for r in jax.device_get(fit(jnp.asarray(xs), jnp.asarray(masks)))
             )
-        )
+            for row, i in enumerate(chunk):
+                w = weights[row]
+                out[i] = ColumnGMM(
+                    means=means[row],
+                    stds=np.maximum(stds[row], 1e-9),
+                    weights=w,
+                    active=w > eps,
+                    # posterior extras: predict_proba then evaluates the
+                    # exact variational E-step instead of the Gaussian
+                    # approximation
+                    mean_precision=mean_prec[row],
+                    dof=dof[row],
+                    stick_a=stick_a[row],
+                    stick_b=stick_b[row],
+                )
     return out
+
+
+def fit_columns_jax(
+    columns: "list[np.ndarray]",
+    n_components: int = 10,
+    eps: float = 0.005,
+    max_iter: int = 100,
+    reg_covar: float = 1e-6,
+    wc_prior: float = 0.001,
+):
+    """Fit every column in one jitted, vmapped program; returns ColumnGMMs."""
+    cols = [np.asarray(c, dtype=np.float32).reshape(-1) for c in columns]
+    if not cols:
+        return []
+    with _span(
+        "init.bgm_fit_jax", columns=len(cols), n_max=max(len(c) for c in cols)
+    ):
+        return _fit_flat(cols, n_components, eps, max_iter, reg_covar, wc_prior)
+
+
+def fit_shards_jax(
+    shard_columns: "list[list[np.ndarray]]",
+    n_components: int = 10,
+    eps: float = 0.005,
+    max_iter: int = 100,
+    reg_covar: float = 1e-6,
+    wc_prior: float = 0.001,
+):
+    """Fit every continuous column of every client shard in a handful of
+    batched device dispatches.
+
+    ``shard_columns[i]`` is client i's list of 1-D columns; the ragged
+    client x column structure flattens into one shape-bucketed batch (the
+    leading axis of ``_fit_batch``'s vmap is *clients x columns*, not just
+    columns), so a whole cohort onboards per dispatch instead of one jit
+    round-trip per client.  Returns the same ragged structure of ColumnGMMs.
+    """
+    flat: list[np.ndarray] = []
+    offsets = [0]
+    for shard in shard_columns:
+        flat.extend(np.asarray(c, dtype=np.float32).reshape(-1) for c in shard)
+        offsets.append(len(flat))
+    if not flat:
+        return [[] for _ in shard_columns]
+    with _span(
+        "init.bgm_fit_shards",
+        clients=len(shard_columns),
+        columns=len(flat),
+        n_max=max(len(c) for c in flat),
+    ):
+        fitted = _fit_flat(flat, n_components, eps, max_iter, reg_covar, wc_prior)
+    return [fitted[offsets[i] : offsets[i + 1]] for i in range(len(shard_columns))]

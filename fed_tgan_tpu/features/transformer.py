@@ -76,11 +76,16 @@ class ModeNormalizer:
         categorical_idx: Sequence[int] = (),
         ordinal_idx: Sequence[int] = (),
         column_names: Optional[Sequence[str]] = None,
+        column_gmms: Optional[dict] = None,
     ) -> "ModeNormalizer":
         """Fit per-column models on a (rows, cols) numeric matrix.
 
         Discrete slot order is local frequency order, like the reference's
-        ``get_metadata`` (transformers.py:22-29).
+        ``get_metadata`` (transformers.py:22-29).  ``column_gmms`` injects
+        already-fitted continuous models (column index -> ColumnGMM) — the
+        cohort-batched onboarding path fits whole client batches in one
+        device program (``bgm_jax.fit_shards_jax``) and installs the results
+        here, so per-client ``fit`` does only the cheap discrete bookkeeping.
         """
         data = np.asarray(data, dtype=np.float64)
         discrete = set(categorical_idx) | set(ordinal_idx)
@@ -88,10 +93,18 @@ class ModeNormalizer:
         # process pool (bit-identical to the serial loop — same estimator,
         # same seed per column)
         cont_idx = [j for j in range(data.shape[1]) if j not in discrete]
-        gmms = dict(zip(cont_idx, fit_column_gmms(
-            [data[:, j] for j in cont_idx],
-            self.n_components, self.eps, self.backend, self.seed,
-        )))
+        if column_gmms is not None:
+            missing = [j for j in cont_idx if j not in column_gmms]
+            if missing:
+                raise ValueError(
+                    f"column_gmms missing continuous columns {missing}"
+                )
+            gmms = {j: column_gmms[j] for j in cont_idx}
+        else:
+            gmms = dict(zip(cont_idx, fit_column_gmms(
+                [data[:, j] for j in cont_idx],
+                self.n_components, self.eps, self.backend, self.seed,
+            )))
         self.columns = []
         for j in range(data.shape[1]):
             name = column_names[j] if column_names is not None else str(j)

@@ -5,11 +5,16 @@ from fed_tgan_tpu.federation.init import (
     harmonize_categories,
     harmonize_continuous,
 )
+from fed_tgan_tpu.federation.init_cache import InitCache, shard_fingerprint
+from fed_tgan_tpu.federation.streaming import OnboardingSession
 
 __all__ = [
     "FederatedInit",
+    "InitCache",
+    "OnboardingSession",
     "aggregation_weights",
     "federated_initialize",
     "harmonize_categories",
     "harmonize_continuous",
+    "shard_fingerprint",
 ]

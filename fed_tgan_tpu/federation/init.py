@@ -63,7 +63,8 @@ def _normalize_per_column(dist: np.ndarray, n_clients: int) -> np.ndarray:
 
 def harmonize_categories(
     local_metas: Sequence[dict],
-) -> tuple[dict, list[CategoryEncoder], np.ndarray]:
+    raw: bool = False,
+):
     """Merge per-client local metas into the harmonized global meta.
 
     Returns (global_meta_dict, encoders, jsd):
@@ -71,6 +72,10 @@ def harmonize_categories(
       replaced by the globally-frequency-ordered category list;
     - encoders: one per categorical column, fitted on the global vocabulary;
     - jsd: (n_clients, n_categorical) per-column normalized JSD scores.
+
+    With ``raw=True`` two extras follow: the unnormalized JSD matrix and
+    the per-column global count vectors (indexed by encoder code) — the
+    frozen reference streaming registration scores newcomers against.
     """
     n_clients = len(local_metas)
     base = copy.deepcopy(local_metas[0])
@@ -102,6 +107,7 @@ def harmonize_categories(
 
     encoders: list[CategoryEncoder] = []
     jsd = np.zeros((n_clients, len(cat_cols)))
+    global_counts: list[np.ndarray] = []
 
     for cursor, col_idx in enumerate(cat_cols):
         merged: dict[str, int] = {}
@@ -121,6 +127,7 @@ def harmonize_categories(
         codes = {k: int(enc.transform([k])[0]) for k in ordered}
         for key, count in merged.items():
             vec_global[codes[key]] = count
+        global_counts.append(vec_global)
 
         for ci, meta in enumerate(local_metas):
             vec = np.zeros(vocab)
@@ -129,6 +136,9 @@ def harmonize_categories(
             jsd[ci, cursor] = _sdistance.jensenshannon(vec_global, vec)
 
     jsd = np.nan_to_num(jsd, nan=0.0)
+    if raw:
+        return (base, encoders, _normalize_per_column(jsd, n_clients),
+                jsd, global_counts)
     return base, encoders, _normalize_per_column(jsd, n_clients)
 
 
@@ -139,23 +149,62 @@ def harmonize_continuous(
     n_components: int = N_CLUSTERS,
     eps: float = WEIGHT_EPS,
     backend: str = "sklearn",
-) -> tuple[list[Optional[ColumnGMM]], np.ndarray]:
-    """Pool rows-proportional samples of the per-client column GMMs, refit
-    global GMMs, and score clients by Wasserstein distance to the pool.
+    method: str = "exact",
+    pool_budget: int = 0,
+    grid_points: int = 0,
+    raw: bool = False,
+):
+    """Score clients by Wasserstein distance to the pooled reference and
+    refit global GMMs on it.
 
     ``client_gmms[i][j]`` is client i's GMM for column j (None when
     discrete).  Returns (global_gmms_per_column, wd) where wd is
-    (n_clients, n_continuous) normalized.
+    (n_clients, n_continuous) normalized; ``raw=True`` appends the
+    unnormalized matrix.
+
+    ``method="exact"`` is the reference protocol: draw a rows-proportional
+    Monte-Carlo sample from every client, pool, empirical WD per client,
+    refit on the full pool — O(N) host passes over O(total rows) draws.
+    ``method="sketch"`` computes the same scores from the *analytic*
+    mixture CDFs in one batched device program and refits on a
+    fixed-budget draw from the pooled mixture (see federation/sketch.py),
+    making this phase O(cohort-batch) instead of O(N).
     """
     n_clients = len(client_gmms)
     n_cols = len(client_gmms[0])
     n_sample = int(np.sum(rows_per_client))
-    by_number = [float(r) / n_sample for r in rows_per_client]
-    rng = np.random.default_rng(seed)
 
     cont_cols = [j for j in range(n_cols) if client_gmms[0][j] is not None]
     wd = np.zeros((n_clients, len(cont_cols)))
     global_gmms: list[Optional[ColumnGMM]] = [None] * n_cols
+
+    if method == "sketch":
+        from fed_tgan_tpu.federation import sketch as _sketch
+
+        stacks = _sketch.stack_client_gmms(client_gmms, cont_cols)
+        wd = _sketch.wd_sketch(
+            client_gmms, rows_per_client, cont_cols,
+            grid_points=grid_points or _sketch.GRID_POINTS, stacks=stacks,
+        )
+        budget = min(pool_budget or _sketch.POOL_BUDGET, n_sample)
+        pooled_cols = _sketch.pooled_mixture_sample(
+            client_gmms, rows_per_client, cont_cols, budget=budget,
+            seed=seed, stacks=stacks,
+        )
+        refits = fit_column_gmms(
+            pooled_cols, n_components=n_components, eps=eps, backend=backend,
+            seed=seed,
+        )
+        for j, gmm in zip(cont_cols, refits):
+            global_gmms[j] = gmm
+        if raw:
+            return global_gmms, _normalize_per_column(wd, n_clients), wd
+        return global_gmms, _normalize_per_column(wd, n_clients)
+    if method != "exact":
+        raise ValueError(f"unknown similarity method {method!r}")
+
+    by_number = [float(r) / n_sample for r in rows_per_client]
+    rng = np.random.default_rng(seed)
 
     # sampling + WD stay serial (they share one rng stream and are cheap).
     # Pooled refits go to a process pool only when workers are opted in —
@@ -188,6 +237,8 @@ def harmonize_continuous(
         for j, gmm in zip(cont_cols, refits):
             global_gmms[j] = gmm
 
+    if raw:
+        return global_gmms, _normalize_per_column(wd, n_clients), wd
     return global_gmms, _normalize_per_column(wd, n_clients)
 
 
@@ -228,10 +279,96 @@ class FederatedInit:
     jsd: np.ndarray
     wd: np.ndarray
     rows_per_client: list[int] = field(default_factory=list)
+    # raw (pre-normalization) similarity scores + the frozen global
+    # references — what streaming registration (federation/streaming.py)
+    # needs to admit newcomers without recomputing the resident population
+    jsd_raw: Optional[np.ndarray] = None
+    wd_raw: Optional[np.ndarray] = None
+    onboarding: Optional[dict] = None
 
     @property
     def output_info(self):
         return self.transformers[0].output_info
+
+
+def _onboarding_state(client_gmms, cont_idx, cat_idx, jsd_raw, wd_raw,
+                      cat_counts, seed, backend, weighted, similarity):
+    """Frozen references streaming registration scores newcomers against."""
+    from fed_tgan_tpu.federation import sketch as _sketch
+
+    mix_means, mix_stds, mix_weights = _sketch.stack_client_gmms(
+        client_gmms, cont_idx, n_components=N_CLUSTERS
+    )
+    return {
+        "jsd_raw": np.asarray(jsd_raw, dtype=np.float64),
+        "wd_raw": np.asarray(wd_raw, dtype=np.float64),
+        "cat_counts": [np.asarray(c, dtype=np.float64) for c in cat_counts],
+        "mix_means": mix_means,
+        "mix_stds": mix_stds,
+        "mix_weights": mix_weights,
+        "cont_idx": list(cont_idx),
+        "cat_idx": list(cat_idx),
+        "params": {"seed": seed, "backend": backend, "weighted": weighted,
+                   "similarity": similarity},
+    }
+
+
+def _restore_from_cache(entry: dict, backend: str, seed: int,
+                        transform_matrices: bool) -> FederatedInit:
+    """Rebuild a FederatedInit from a global cache entry.
+
+    Matrices come back byte-for-byte from the entry (never re-transformed),
+    so a warm run is bit-identical to the cold run that stored it.
+    """
+    payload, arrays = entry["payload"], entry["arrays"]
+    global_meta = TableMeta.from_json_dict(payload["global_meta"])
+    encoders = [
+        CategoryEncoder.fit([str(v) for v in cmeta.i2s])
+        for cmeta in global_meta.columns
+        if not cmeta.is_continuous
+    ]
+    n_cols = len(global_meta.columns)
+    global_gmms: list[Optional[ColumnGMM]] = [None] * n_cols
+    for j_str, d in payload["gmms"].items():
+        global_gmms[int(j_str)] = ColumnGMM.from_dict(d)
+    rows_per_client = [int(r) for r in payload["rows_per_client"]]
+    n_clients = len(rows_per_client)
+    transformers = [
+        ModeNormalizer(backend=backend, seed=seed).refit_with_global(
+            global_meta, encoders, global_gmms
+        )
+        for _ in range(n_clients)
+    ]
+    client_matrices = (
+        [arrays[f"m{i}"] for i in range(n_clients)]
+        if transform_matrices else []
+    )
+    onboarding = {
+        "jsd_raw": arrays["jsd_raw"],
+        "wd_raw": arrays["wd_raw"],
+        "cat_counts": [
+            arrays[f"cat_counts{c}"] for c in range(len(encoders))
+        ],
+        "mix_means": arrays["mix_means"],
+        "mix_stds": arrays["mix_stds"],
+        "mix_weights": arrays["mix_weights"],
+        "cont_idx": [int(j) for j in payload["cont_idx"]],
+        "cat_idx": [int(j) for j in payload["cat_idx"]],
+        "params": payload["params"],
+    }
+    return FederatedInit(
+        global_meta=global_meta,
+        encoders=encoders,
+        transformers=transformers,
+        client_matrices=client_matrices,
+        weights=arrays["weights"],
+        jsd=arrays["jsd"],
+        wd=arrays["wd"],
+        rows_per_client=rows_per_client,
+        jsd_raw=arrays["jsd_raw"],
+        wd_raw=arrays["wd_raw"],
+        onboarding=onboarding,
+    )
 
 
 def federated_initialize(
@@ -239,6 +376,10 @@ def federated_initialize(
     seed: int = 0,
     backend: str = "sklearn",
     weighted: bool = True,
+    similarity: str = "exact",
+    batch_fit: Optional[bool] = None,
+    cache=None,
+    transform_matrices: bool = True,
 ) -> FederatedInit:
     """Run the full init protocol over in-process client shards.
 
@@ -246,8 +387,35 @@ def federated_initialize(
     uniform_meta_category -> uniform_continuous_gmm -> refit_local_transformer
     -> calculate_final_weights_for_aggregation.  ``weighted=False`` yields
     uniform FedAvg weights (the reference's ``average_model_ordinary``).
+
+    Onboarding-at-scale knobs (all default to the reference behavior):
+
+    - ``similarity="sketch"`` scores WD from the analytic mixture CDFs in
+      one batched device program instead of N Monte-Carlo host passes
+      (federation/sketch.py) — same scores in expectation, O(cohort) cost;
+    - ``batch_fit`` (default: on for the jax backend) fits every client's
+      continuous columns in a handful of batched device dispatches
+      (``fit_shards_jax``) instead of one jit round-trip per client;
+    - ``cache`` (a directory path or :class:`InitCache`) persists
+      content-hashed client fits and the finished global state; warm hits
+      restore bit-identical encoded matrices without refitting;
+    - ``transform_matrices=False`` skips materializing the per-client
+      encoded matrices (registration-only / encoded-only onboarding, e.g.
+      scoring a huge population before deciding which cohort trains).
     """
+    from fed_tgan_tpu.federation.init_cache import (
+        InitCache,
+        global_key,
+        shard_fingerprint,
+    )
+
     n_clients = len(clients)
+    total_rows = int(sum(c.n_rows for c in clients))
+    cache = InitCache.resolve(cache)
+    use_batch = (batch_fit if batch_fit is not None
+                 else backend == "jax") and backend == "jax"
+    if similarity not in ("exact", "sketch"):
+        raise ValueError(f"unknown similarity {similarity!r}")
 
     # each protocol phase is spanned + journaled (`init_phase`) so
     # `obs report` can decompose the onboarding wall at scale -- the
@@ -255,12 +423,51 @@ def federated_initialize(
     def _phase_done(phase: str, t0: float) -> None:
         _emit_event("init_phase", phase=phase,
                     seconds=round(time.perf_counter() - t0, 6),
-                    clients=n_clients)
+                    clients=n_clients, rows=total_rows)
+
+    fps: list[str] = []
+    gkey = None
+    cached_clients: dict[int, dict] = {}
+    if cache is not None:
+        t0 = time.perf_counter()
+        with _span("init.cache_lookup", clients=n_clients):
+            fps = [
+                shard_fingerprint(c, n_components=N_CLUSTERS,
+                                  backend=backend, seed=seed)
+                for c in clients
+            ]
+            gkey = global_key(
+                fps, seed=seed, backend=backend, weighted=weighted,
+                similarity=similarity, matrices=transform_matrices,
+            )
+            entry = cache.load_global(gkey)
+            if entry is None:
+                for i, fp in enumerate(fps):
+                    hit = cache.load_client(fp)
+                    if hit is not None:
+                        cached_clients[i] = hit
+        _phase_done("cache_lookup", t0)
+        if entry is not None:
+            t0 = time.perf_counter()
+            with _span("init.cache_restore", clients=n_clients):
+                init = _restore_from_cache(
+                    entry, backend=backend, seed=seed,
+                    transform_matrices=transform_matrices,
+                )
+            _phase_done("cache_restore", t0)
+            cache.flush_events()
+            return init
 
     t0 = time.perf_counter()
     with _span("init.category_harmonize", clients=n_clients):
-        local_metas = [c.local_meta() for c in clients]
-        global_meta_dict, encoders, jsd = harmonize_categories(local_metas)
+        local_metas = [
+            cached_clients[i]["local_meta"] if i in cached_clients
+            else c.local_meta()
+            for i, c in enumerate(clients)
+        ]
+        global_meta_dict, encoders, jsd, jsd_raw, cat_counts = (
+            harmonize_categories(local_metas, raw=True)
+        )
     _phase_done("category_harmonize", t0)
 
     t0 = time.perf_counter()
@@ -272,20 +479,49 @@ def federated_initialize(
     _phase_done("encode", t0)
 
     # local per-column GMM fits (client-side in the reference) -- the
-    # dominant init cost at scale (one BGM fit per client per column)
+    # dominant init cost at scale.  Batched mode flattens the whole cohort
+    # into shape-bucketed device dispatches; cached clients skip the fit
+    # entirely and inject their stored GMMs into the transformer.
     t0 = time.perf_counter()
     with _span("init.local_bgm_fit", clients=n_clients):
-        local_tfs = [
-            ModeNormalizer(backend=backend, seed=seed).fit(m, cat_idx)
-            for m in matrices
-        ]
+        n_cols = matrices[0].shape[1]
+        cont_idx = [j for j in range(n_cols) if j not in set(cat_idx)]
+        gmms_by_client: dict[int, dict] = {
+            i: hit["gmms"] for i, hit in cached_clients.items()
+        }
+        need = [i for i in range(n_clients) if i not in gmms_by_client]
+        if use_batch and need:
+            from fed_tgan_tpu.features.bgm_jax import fit_shards_jax
+
+            fitted = fit_shards_jax(
+                [[matrices[i][:, j] for j in cont_idx] for i in need],
+                n_components=N_CLUSTERS, eps=WEIGHT_EPS,
+            )
+            for i, gl in zip(need, fitted):
+                gmms_by_client[i] = dict(zip(cont_idx, gl))
+        local_tfs = []
+        for i in range(n_clients):
+            pre = gmms_by_client.get(i)
+            tf = ModeNormalizer(backend=backend, seed=seed).fit(
+                matrices[i], cat_idx, column_gmms=pre
+            )
+            local_tfs.append(tf)
+            if pre is None:
+                all_gmms = tf.column_gmms
+                gmms_by_client[i] = {j: all_gmms[j] for j in cont_idx}
         client_gmms = [tf.column_gmms for tf in local_tfs]
+        if cache is not None:
+            for i in range(n_clients):
+                if i not in cached_clients:
+                    cache.store_client(fps[i], local_metas[i],
+                                       gmms_by_client[i])
     _phase_done("local_bgm_fit", t0)
 
     t0 = time.perf_counter()
     with _span("init.continuous_harmonize", clients=n_clients):
-        global_gmms, wd = harmonize_continuous(
-            client_gmms, rows_per_client, seed=seed, backend=backend
+        global_gmms, wd, wd_raw = harmonize_continuous(
+            client_gmms, rows_per_client, seed=seed, backend=backend,
+            method=similarity, raw=True,
         )
     _phase_done("continuous_harmonize", t0)
 
@@ -299,9 +535,10 @@ def federated_initialize(
                 global_meta, encoders, global_gmms
             )
             transformers.append(tf)
-            client_matrices.append(
-                tf.transform(matrices[i], rng=np.random.default_rng(seed + i))
-            )
+            if transform_matrices:
+                client_matrices.append(
+                    tf.transform(matrices[i], rng=np.random.default_rng(seed + i))
+                )
     _phase_done("refit_transform", t0)
 
     t0 = time.perf_counter()
@@ -310,9 +547,13 @@ def federated_initialize(
             weights = aggregation_weights(jsd, wd, rows_per_client)
         else:
             weights = np.full(n_clients, 1.0 / n_clients)
+        onboarding = _onboarding_state(
+            client_gmms, cont_idx, cat_idx, jsd_raw, wd_raw, cat_counts,
+            seed, backend, weighted, similarity,
+        )
     _phase_done("aggregation_weights", t0)
 
-    return FederatedInit(
+    init = FederatedInit(
         global_meta=global_meta,
         encoders=encoders,
         transformers=transformers,
@@ -321,4 +562,38 @@ def federated_initialize(
         jsd=jsd,
         wd=wd,
         rows_per_client=rows_per_client,
+        jsd_raw=jsd_raw,
+        wd_raw=wd_raw,
+        onboarding=onboarding,
     )
+
+    if cache is not None:
+        t0 = time.perf_counter()
+        with _span("init.cache_store", clients=n_clients):
+            payload = {
+                "global_meta": global_meta_dict,
+                "gmms": {
+                    str(j): g.to_dict()
+                    for j, g in enumerate(global_gmms) if g is not None
+                },
+                "cont_idx": list(cont_idx),
+                "cat_idx": list(cat_idx),
+                "rows_per_client": list(map(int, rows_per_client)),
+                "params": onboarding["params"],
+            }
+            arrays = {
+                "jsd": jsd, "wd": wd, "jsd_raw": jsd_raw, "wd_raw": wd_raw,
+                "weights": np.asarray(weights, dtype=np.float64),
+                "mix_means": onboarding["mix_means"],
+                "mix_stds": onboarding["mix_stds"],
+                "mix_weights": onboarding["mix_weights"],
+            }
+            for c, vec in enumerate(cat_counts):
+                arrays[f"cat_counts{c}"] = vec
+            if transform_matrices:
+                for i, m in enumerate(client_matrices):
+                    arrays[f"m{i}"] = m
+            cache.store_global(gkey, payload, arrays)
+        _phase_done("cache_store", t0)
+        cache.flush_events()
+    return init

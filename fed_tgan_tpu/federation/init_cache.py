@@ -1,0 +1,239 @@
+"""Content-hashed encoded-shard cache for federated onboarding.
+
+Re-running init over an unchanged population (restarts, resumed sweeps,
+late joiners next to a resident cohort) repays the full fit cost for
+nothing: the inputs are bit-identical.  This cache keys every piece of
+init-time state on content fingerprints in the style of
+``runtime/checkpoint.checkpoint_fingerprint`` — sha256 over the actual
+bytes that determine the result — so a hit is *provably* the same
+computation and the restored output is bit-identical (test-gated):
+
+- **client entries** (``client-<fp>.json``): one per shard fingerprint,
+  holding the local meta dict and the per-column local GMM fits.  The
+  fingerprint covers the preprocessed shard bytes, the schema knobs, the
+  fit hyperparameters and :data:`ENCODER_VERSION`, so a schema or encoder
+  change invalidates by construction (no TTLs, no mtime races).  Local
+  fits depend on nothing global (label encoding touches categorical
+  columns only), which is what makes per-client reuse sound when the
+  population around a client changes.
+- **global entries** (``global-<gkey>.npz``): keyed over the *ordered*
+  client fingerprint list plus the init parameters; holds the harmonized
+  meta, global GMMs, similarity scores, aggregation weights and the
+  transformed per-client matrices — a warm re-run restores the whole
+  ``FederatedInit`` without touching a single shard fit.
+
+Every payload publishes atomically (tmp + ``os.replace``) next to a
+manifest recording the payload's sha256; a mismatch or unreadable file is
+counted as ``corrupt`` and treated as a miss (the caller refits and the
+store overwrites the rotten entry).  ``testing/faults.py`` can truncate
+the n-th store (``corrupt_cache:nth=N``) to drill exactly that path.
+
+Outcomes are journaled as aggregate ``init_cache`` events (op x scope
+counts, never one line per client) summarized by ``obs report``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+
+# bump when the encoded representation or the fit pipeline changes shape:
+# every fingerprint embeds it, so old entries all miss at once
+ENCODER_VERSION = 1
+
+_DIGEST_CHARS = 16
+
+
+def shard_fingerprint(client, *, n_components: int, backend: str,
+                      seed: int) -> str:
+    """Content hash of one participant's preprocessed shard.
+
+    Streams the post-``__post_init__`` dataframe (the actual fit input)
+    plus every knob that shapes the local fit; raw-bytes identity of the
+    source CSV is neither necessary nor sufficient — two CSVs that
+    preprocess identically SHOULD share an entry.
+    """
+    h = hashlib.sha256()
+    h.update(
+        f"encoder-v{ENCODER_VERSION}|{backend}|{seed}|{n_components}".encode()
+    )
+    df = client.df
+    h.update(repr(list(df.columns)).encode())
+    h.update(repr(sorted(map(str, client.categorical_columns))).encode())
+    h.update(repr(sorted(map(str, client.non_negative_columns))).encode())
+    h.update(repr(sorted(client.date_formats.items())).encode())
+    for name in df.columns:
+        col = df[name]
+        if col.dtype.kind in "ifbu":
+            h.update(np.ascontiguousarray(col.to_numpy()).tobytes())
+        else:
+            h.update("\x1f".join(col.astype(str)).encode())
+        h.update(b"\x1e")
+    return h.hexdigest()[:_DIGEST_CHARS]
+
+
+def global_key(fingerprints: list[str], **params) -> str:
+    """Key over the ORDERED client fingerprints + init parameters (client
+    order feeds per-client transform seeds and the weight vector layout,
+    so a permuted population is a different computation)."""
+    h = hashlib.sha256()
+    h.update("|".join(fingerprints).encode())
+    h.update(repr(sorted(params.items())).encode())
+    return h.hexdigest()[:_DIGEST_CHARS]
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _maybe_corrupt(path: str) -> None:
+    from fed_tgan_tpu.testing.faults import active_plan
+
+    plan = active_plan()
+    if plan is not None:
+        plan.on_cache_store(path)
+
+
+class InitCache:
+    """One cache directory; counters aggregate until :meth:`flush_events`."""
+
+    def __init__(self, root: str) -> None:
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.counts: dict[tuple[str, str], int] = {}
+
+    @classmethod
+    def resolve(cls, cache) -> Optional["InitCache"]:
+        if cache is None or isinstance(cache, cls):
+            return cache
+        return cls(str(cache))
+
+    def _note(self, op: str, scope: str, n: int = 1) -> None:
+        if n:
+            self.counts[(op, scope)] = self.counts.get((op, scope), 0) + n
+
+    def flush_events(self) -> None:
+        """Emit one aggregate ``init_cache`` journal event per (op, scope)."""
+        for (op, scope), count in sorted(self.counts.items()):
+            _emit_event("init_cache", op=op, scope=scope, count=count,
+                        root=self.root)
+        self.counts.clear()
+
+    # ---------------------------------------------------------- client scope
+
+    def _client_path(self, fp: str) -> str:
+        return os.path.join(self.root, f"client-{fp}.json")
+
+    def load_client(self, fp: str) -> Optional[dict]:
+        """Returns ``{"local_meta": dict, "gmms": {int col: ColumnGMM}}`` or
+        None (miss).  Digest mismatch / unparseable file counts ``corrupt``
+        and is a miss."""
+        from fed_tgan_tpu.features.bgm import ColumnGMM
+
+        path = self._client_path(fp)
+        if not os.path.exists(path):
+            self._note("miss", "client")
+            return None
+        try:
+            with open(path, "rb") as f:
+                doc = json.loads(f.read().decode())
+            payload = doc["payload"]
+            blob = json.dumps(payload, sort_keys=True).encode()
+            if (doc.get("version") != ENCODER_VERSION
+                    or doc.get("sha256") != hashlib.sha256(blob).hexdigest()):
+                raise ValueError("digest or version mismatch")
+            gmms = {
+                int(j): ColumnGMM.from_dict(d)
+                for j, d in payload["gmms"].items()
+            }
+        except (ValueError, KeyError, TypeError, OSError):
+            self._note("corrupt", "client")
+            return None
+        self._note("hit", "client")
+        return {"local_meta": payload["local_meta"], "gmms": gmms}
+
+    def store_client(self, fp: str, local_meta: dict, gmms: dict) -> None:
+        payload = {
+            "local_meta": local_meta,
+            "gmms": {str(j): g.to_dict() for j, g in gmms.items()},
+        }
+        blob = json.dumps(payload, sort_keys=True).encode()
+        doc = {
+            "version": ENCODER_VERSION,
+            "sha256": hashlib.sha256(blob).hexdigest(),
+            "payload": payload,
+        }
+        path = self._client_path(fp)
+        _atomic_write(path, json.dumps(doc, sort_keys=True).encode())
+        self._note("store", "client")
+        _maybe_corrupt(path)
+
+    # ---------------------------------------------------------- global scope
+
+    def _global_paths(self, gkey: str) -> tuple[str, str]:
+        base = os.path.join(self.root, f"global-{gkey}")
+        return base + ".npz", base + ".json"
+
+    def load_global(self, gkey: str) -> Optional[dict]:
+        """Returns ``{"payload": dict, "arrays": {name: ndarray}}`` or None."""
+        npz_path, man_path = self._global_paths(gkey)
+        if not (os.path.exists(npz_path) and os.path.exists(man_path)):
+            self._note("miss", "global")
+            return None
+        try:
+            with open(man_path, "rb") as f:
+                manifest = json.loads(f.read().decode())
+            if (manifest.get("version") != ENCODER_VERSION
+                    or manifest.get("sha256") != _sha256_file(npz_path)):
+                raise ValueError("digest or version mismatch")
+            with np.load(npz_path, allow_pickle=False) as z:
+                arrays = {k: z[k] for k in z.files}
+            payload = json.loads(str(arrays.pop("payload")[()]))
+        except (ValueError, KeyError, TypeError, OSError,
+                json.JSONDecodeError):
+            self._note("corrupt", "global")
+            return None
+        self._note("hit", "global")
+        return {"payload": payload, "arrays": arrays}
+
+    def store_global(self, gkey: str, payload: dict, arrays: dict) -> None:
+        import io
+
+        npz_path, man_path = self._global_paths(gkey)
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            payload=np.asarray(json.dumps(payload, sort_keys=True)),
+            **arrays,
+        )
+        _atomic_write(npz_path, buf.getvalue())
+        manifest = {
+            "version": ENCODER_VERSION,
+            "sha256": _sha256_file(npz_path),
+        }
+        _atomic_write(man_path, json.dumps(manifest, sort_keys=True).encode())
+        self._note("store", "global")
+        _maybe_corrupt(npz_path)

@@ -1,0 +1,193 @@
+"""Device-computed similarity sketches for O(cohort) onboarding.
+
+The exact init path scores every client by Wasserstein distance between a
+rows-proportional Monte-Carlo sample of its column GMM and the pooled
+sample over all clients (reference Server/dtds/distributed.py:689-765) —
+N host passes over O(total rows) draws per column, the second superlinear
+term of the onboarding wall.
+
+The sketch uses what the fit already gives us analytically: client i's
+fitted column GMM has CDF ``F_i(x) = sum_k w_ik Phi((x - mu_ik)/s_ik)``,
+the pooled reference is the rows-weighted mixture ``F_bar = sum_i w_i F_i``,
+and ``W1(F_i, F_bar) = integral |F_i(x) - F_bar(x)| dx`` — evaluated on a
+shared per-column grid in ONE jitted device program over (clients x
+columns x grid).  The exact path's sampled WD is the Monte-Carlo estimate
+of this same integral, so sketch scores agree in expectation and the
+downstream softmax weights agree to sampling noise (gated in
+tests/test_onboard.py and the BENCH_r13 parity record).
+
+The pooled global refit keeps a matching budget trick: the pool IS a known
+mixture (N x K components with weights ``omega_i * w_ik``), so one
+fixed-budget vectorized draw from it replaces the per-client sampling
+loop, making the global refit cost independent of N.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+from fed_tgan_tpu.obs.trace import span as _span
+
+GRID_POINTS = 512
+POOL_BUDGET = 65536
+_TAIL_SIGMAS = 4.5
+
+
+def stack_client_gmms(
+    client_gmms: Sequence[Sequence[object]],
+    cont_cols: Sequence[int],
+    n_components: Optional[int] = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stack per-client per-column GMMs into (N, C, K) arrays.
+
+    Degenerate clients (component clamp on tiny shards) pad with zero-weight
+    components (std 1 so the CDF term stays finite); zero weight keeps them
+    out of both the sketch and the pooled draw.
+    """
+    n_clients = len(client_gmms)
+    if n_components is None:
+        n_components = max(
+            client_gmms[i][j].n_components
+            for i in range(n_clients)
+            for j in cont_cols
+        )
+    shape = (n_clients, len(cont_cols), n_components)
+    means = np.zeros(shape, dtype=np.float64)
+    stds = np.ones(shape, dtype=np.float64)
+    weights = np.zeros(shape, dtype=np.float64)
+    for i in range(n_clients):
+        for cursor, j in enumerate(cont_cols):
+            g = client_gmms[i][j]
+            k = g.n_components
+            means[i, cursor, :k] = g.means
+            stds[i, cursor, :k] = np.maximum(g.stds, 1e-9)
+            w = np.maximum(g.weights, 0.0)
+            weights[i, cursor, :k] = w / max(w.sum(), 1e-300)
+    return means, stds, weights
+
+
+def _wd_impl(means, stds, weights, omega, grid):
+    """(N, C, K) mixtures + (N,) pool weights + (C, G) grid -> (N, C) W1."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.scipy.stats import norm
+
+    n, c, k = means.shape
+    g = grid.shape[1]
+
+    def accumulate(acc, i):
+        z = (grid[None, :, :] - means[:, :, i, None]) / stds[:, :, i, None]
+        return acc + weights[:, :, i, None] * norm.cdf(z), None
+
+    cdf, _ = lax.scan(
+        accumulate, jnp.zeros((n, c, g), means.dtype), jnp.arange(k)
+    )
+    pooled = jnp.einsum("ncg,n->cg", cdf, omega)
+    dx = (grid[:, -1] - grid[:, 0]) / (g - 1)
+    return jnp.abs(cdf - pooled[None, :, :]).sum(axis=-1) * dx[None, :]
+
+
+@functools.lru_cache(maxsize=None)
+def _wd_fn():
+    import jax
+
+    return jax.jit(_wd_impl)
+
+
+def column_grids(
+    means: np.ndarray,
+    stds: np.ndarray,
+    weights: np.ndarray,
+    grid_points: int = GRID_POINTS,
+) -> np.ndarray:
+    """Shared (C, G) integration grid spanning every active component's
+    mean +- 4.5 sigma (host-side — bounds are data-dependent shapes)."""
+    valid = weights > 0.0
+    lo_all = np.where(valid, means - _TAIL_SIGMAS * stds, np.inf)
+    hi_all = np.where(valid, means + _TAIL_SIGMAS * stds, -np.inf)
+    lo = lo_all.min(axis=(0, 2))
+    hi = hi_all.max(axis=(0, 2))
+    bad = ~np.isfinite(lo) | ~np.isfinite(hi) | (hi <= lo)
+    lo = np.where(bad, np.where(np.isfinite(lo), lo, 0.0) - 0.5, lo)
+    hi = np.where(bad, lo + 1.0, hi)
+    steps = np.arange(grid_points, dtype=np.float64) / (grid_points - 1)
+    return lo[:, None] + (hi - lo)[:, None] * steps[None, :]
+
+
+def wd_sketch(
+    client_gmms: Sequence[Sequence[object]],
+    rows_per_client: Sequence[int],
+    cont_cols: Sequence[int],
+    grid_points: int = GRID_POINTS,
+    omega: Optional[np.ndarray] = None,
+    stacks: Optional[tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+) -> np.ndarray:
+    """Raw (unnormalized) per-client per-column W1 against the pooled
+    reference, one batched device program.
+
+    ``omega`` overrides the pool weights (streaming registration passes 0
+    for newcomers so they score against the frozen resident reference).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    means, stds, weights = (
+        stacks if stacks is not None
+        else stack_client_gmms(client_gmms, cont_cols)
+    )
+    n_clients = means.shape[0]
+    if not len(cont_cols):
+        return np.zeros((n_clients, 0), dtype=np.float64)
+    if omega is None:
+        omega = np.asarray(rows_per_client, dtype=np.float64)
+        omega = omega / omega.sum()
+    grid = column_grids(means, stds, weights, grid_points)
+    with _span("init.wd_sketch", clients=n_clients, columns=len(cont_cols)):
+        wd = np.asarray(
+            jax.device_get(
+                _wd_fn()(
+                    jnp.asarray(means, jnp.float32),
+                    jnp.asarray(stds, jnp.float32),
+                    jnp.asarray(weights, jnp.float32),
+                    jnp.asarray(omega, jnp.float32),
+                    jnp.asarray(grid, jnp.float32),
+                )
+            ),
+            dtype=np.float64,
+        )
+    return wd
+
+
+def pooled_mixture_sample(
+    client_gmms: Sequence[Sequence[object]],
+    rows_per_client: Sequence[int],
+    cont_cols: Sequence[int],
+    budget: int = POOL_BUDGET,
+    seed: int = 0,
+    stacks: Optional[tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+) -> list[np.ndarray]:
+    """One budgeted vectorized draw per column from the pooled mixture
+    (components ``omega_i * w_ik``) — the global-refit input whose size no
+    longer grows with the population."""
+    means, stds, weights = (
+        stacks if stacks is not None
+        else stack_client_gmms(client_gmms, cont_cols)
+    )
+    omega = np.asarray(rows_per_client, dtype=np.float64)
+    omega = omega / omega.sum()
+    rng = np.random.default_rng(seed)
+    out = []
+    for cursor in range(len(cont_cols)):
+        flat_w = (omega[:, None] * weights[:, cursor, :]).reshape(-1)
+        flat_w = flat_w / flat_w.sum()
+        comp = rng.choice(flat_w.size, size=budget, p=flat_w)
+        out.append(
+            rng.normal(
+                means[:, cursor, :].reshape(-1)[comp],
+                stds[:, cursor, :].reshape(-1)[comp],
+            )
+        )
+    return out

@@ -1,0 +1,326 @@
+"""Streaming client registration: admit newcomers into a resident init.
+
+``federated_initialize`` prices the whole population at once; a production
+federation doesn't get that luxury — clients show up while a cohort is
+already resident (ROADMAP item 3's churn workload).  An
+:class:`OnboardingSession` wraps a finished :class:`FederatedInit` and
+admits newcomers in cohort-sized batches at O(batch) cost:
+
+- the **global artifacts stay frozen**: harmonized vocabulary, global
+  GMMs, transformer layout (``output_dim`` is a compiled-program shape —
+  changing it would force a retrace mid-training), and the pooled
+  similarity references.  A newcomer whose categories fall outside the
+  frozen vocabulary is rejected (or dropped with ``on_invalid="drop"``) —
+  re-harmonizing is a full re-init by design;
+- newcomers pass the PR 2 init-payload screen (``_all_finite`` over meta,
+  encoded matrix, and fitted GMMs) exactly like remote ranks in
+  ``federation/distributed.py`` — a diverged or hostile shard must not
+  poison the resident weights;
+- their local fits go through the same cohort-batched device path
+  (``fit_shards_jax``) and the same content-hashed cache as cold init;
+- similarity scores are computed against the FROZEN references (global
+  category counts, resident mixture CDF) and appended to the stored *raw*
+  score matrices; per-column normalization and the softmax re-run over
+  the extended population — bit-equal to the reference math over raw
+  distances, so resident scores never need recomputing.
+
+The per-client aggregation weights of residents DO shift when newcomers
+join (the softmax renormalizes — that is the paper's semantics, not an
+artifact); their encoded matrices and transformers are untouched.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+from scipy.spatial import distance as _sdistance
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.features.bgm import N_CLUSTERS, WEIGHT_EPS
+from fed_tgan_tpu.features.transformer import ModeNormalizer
+from fed_tgan_tpu.federation.distributed import _all_finite
+from fed_tgan_tpu.federation.init import (
+    FederatedInit,
+    _normalize_per_column,
+    aggregation_weights,
+)
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.trace import span as _span
+
+
+class OnboardingSession:
+    """Incremental registration over a resident :class:`FederatedInit`.
+
+    ``session.init`` always points at the latest snapshot; every
+    :meth:`register_clients` call returns the new one.  The session object
+    itself is cheap — all state lives in ``init.onboarding``.
+    """
+
+    def __init__(self, init: FederatedInit, cache=None):
+        if init.onboarding is None:
+            raise ValueError(
+                "this FederatedInit predates streaming registration "
+                "(no onboarding state); re-run federated_initialize"
+            )
+        from fed_tgan_tpu.federation.init_cache import InitCache
+
+        self.init = init
+        self.cache = InitCache.resolve(cache)
+
+    @property
+    def n_clients(self) -> int:
+        return len(self.init.rows_per_client)
+
+    def register_clients(
+        self,
+        newcomers: Sequence[TablePreprocessor],
+        on_invalid: str = "raise",
+    ) -> FederatedInit:
+        """Admit a batch of newcomers; returns the extended snapshot.
+
+        ``on_invalid="drop"`` silently skips shards that fail the screen
+        (schema mismatch, unseen categories, non-finite payloads) instead
+        of raising; the returned snapshot covers survivors only.
+        """
+        if on_invalid not in ("raise", "drop"):
+            raise ValueError(f"unknown on_invalid policy {on_invalid!r}")
+        init, ob = self.init, self.init.onboarding
+        params = ob["params"]
+        seed, backend = params["seed"], params["backend"]
+        cont_idx, cat_idx = ob["cont_idx"], ob["cat_idx"]
+        n_res = len(init.rows_per_client)
+        t0 = time.perf_counter()
+
+        with _span("init.register_clients", newcomers=len(newcomers)):
+            admitted, matrices, metas = self._screen(
+                newcomers, cat_idx, on_invalid
+            )
+            if not admitted:
+                return init
+
+            gmms_list = self._fit_locals(admitted, matrices, metas,
+                                         cont_idx, seed, backend)
+            jsd_new = self._jsd_raw(metas, cat_idx)
+            wd_new, stacks_new = self._wd_raw(gmms_list, cont_idx)
+
+            # extended raw scores -> per-column renormalization + softmax
+            # over the WHOLE population (reference math over raw distances)
+            jsd_raw = np.vstack([ob["jsd_raw"], jsd_new])
+            wd_raw = np.vstack([ob["wd_raw"], wd_new])
+            rows = list(init.rows_per_client) + [len(m) for m in matrices]
+            n_all = len(rows)
+            jsd = _normalize_per_column(jsd_raw, n_all)
+            wd = _normalize_per_column(wd_raw, n_all)
+            weights = (
+                aggregation_weights(jsd, wd, rows)
+                if params["weighted"] else np.full(n_all, 1.0 / n_all)
+            )
+
+            # frozen global layout: newcomers get their own transformer
+            # instances and deterministic per-client transform streams
+            # (seed + global index), exactly like cold init
+            transformers = list(init.transformers)
+            client_matrices = list(init.client_matrices)
+            global_gmms = transformers[0].column_gmms
+            for k, m in enumerate(matrices):
+                tf = ModeNormalizer(
+                    backend=backend, seed=seed
+                ).refit_with_global(init.global_meta, init.encoders,
+                                    global_gmms)
+                transformers.append(tf)
+                if init.client_matrices:
+                    client_matrices.append(
+                        tf.transform(
+                            m, rng=np.random.default_rng(seed + n_res + k)
+                        )
+                    )
+
+            onboarding = dict(
+                ob,
+                jsd_raw=jsd_raw,
+                wd_raw=wd_raw,
+                mix_means=np.concatenate([ob["mix_means"], stacks_new[0]]),
+                mix_stds=np.concatenate([ob["mix_stds"], stacks_new[1]]),
+                mix_weights=np.concatenate(
+                    [ob["mix_weights"], stacks_new[2]]
+                ),
+            )
+            self.init = FederatedInit(
+                global_meta=init.global_meta,
+                encoders=init.encoders,
+                transformers=transformers,
+                client_matrices=client_matrices,
+                weights=weights,
+                jsd=jsd,
+                wd=wd,
+                rows_per_client=rows,
+                jsd_raw=jsd_raw,
+                wd_raw=wd_raw,
+                onboarding=onboarding,
+            )
+        _emit_event("init_phase", phase="register_clients",
+                    seconds=round(time.perf_counter() - t0, 6),
+                    clients=n_all, rows=int(np.sum(rows)))
+        return self.init
+
+    # ------------------------------------------------------------ internals
+
+    def _reject(self, why: str, on_invalid: str) -> bool:
+        """True = drop silently, False never returned on raise."""
+        if on_invalid == "raise":
+            raise ValueError(why)
+        _emit_event("client_dropped", reason=why, where="register_clients")
+        return True
+
+    def _screen(self, newcomers, cat_idx, on_invalid):
+        """Schema + vocabulary + finiteness screen (the PR 2 payload
+        screen, applied at admission instead of at transport gather)."""
+        init = self.init
+        gsig = [
+            (c.name, "continous" if c.is_continuous else "categorical")
+            for c in init.global_meta.columns
+        ]
+        vocabs = [
+            {str(v) for v in c.i2s}
+            for c in init.global_meta.columns if not c.is_continuous
+        ]
+        admitted, matrices, metas = [], [], []
+        for c in newcomers:
+            meta = c.local_meta()
+            sig = [(col.get("column_name", ""), col["type"])
+                   for col in meta["columns"]]
+            if sig != gsig:
+                if self._reject(
+                    f"newcomer {meta.get('name', '?')!r}: schema mismatch "
+                    f"with the frozen global meta", on_invalid,
+                ):
+                    continue
+            unseen = []
+            cursor = 0
+            for col in meta["columns"]:
+                if col["type"] != "categorical":
+                    continue
+                extra = set(col["i2s"]) - vocabs[cursor]
+                if extra:
+                    unseen.append((col["column_name"], sorted(extra)[:5]))
+                cursor += 1
+            if unseen:
+                if self._reject(
+                    f"newcomer {meta.get('name', '?')!r}: categories outside "
+                    f"the frozen global vocabulary {unseen}; re-run full "
+                    f"init to re-harmonize", on_invalid,
+                ):
+                    continue
+            matrix, this_cat_idx, _ = c.encode(init.encoders)
+            if this_cat_idx != list(cat_idx):
+                if self._reject(
+                    f"newcomer {meta.get('name', '?')!r}: categorical "
+                    f"column positions {this_cat_idx} != frozen {cat_idx}",
+                    on_invalid,
+                ):
+                    continue
+            if not (_all_finite(meta) and _all_finite(matrix)):
+                if self._reject(
+                    f"newcomer {meta.get('name', '?')!r}: non-finite init "
+                    f"payload", on_invalid,
+                ):
+                    continue
+            admitted.append(c)
+            matrices.append(matrix)
+            metas.append(meta)
+        return admitted, matrices, metas
+
+    def _fit_locals(self, admitted, matrices, metas, cont_idx, seed,
+                    backend):
+        """Cohort-batched (and cache-aware) local fits for the newcomers."""
+        from fed_tgan_tpu.features.bgm import fit_column_gmms
+        from fed_tgan_tpu.federation.init_cache import shard_fingerprint
+
+        gmms_list: list[Optional[dict]] = [None] * len(admitted)
+        fps = []
+        if self.cache is not None:
+            for k, c in enumerate(admitted):
+                fp = shard_fingerprint(c, n_components=N_CLUSTERS,
+                                       backend=backend, seed=seed)
+                fps.append(fp)
+                hit = self.cache.load_client(fp)
+                if hit is not None:
+                    gmms_list[k] = hit["gmms"]
+        need = [k for k in range(len(admitted)) if gmms_list[k] is None]
+        if need:
+            if backend == "jax":
+                from fed_tgan_tpu.features.bgm_jax import fit_shards_jax
+
+                fitted = fit_shards_jax(
+                    [[matrices[k][:, j] for j in cont_idx] for k in need],
+                    n_components=N_CLUSTERS, eps=WEIGHT_EPS,
+                )
+            else:
+                fitted = [
+                    fit_column_gmms(
+                        [matrices[k][:, j] for j in cont_idx],
+                        N_CLUSTERS, WEIGHT_EPS, backend, seed,
+                    )
+                    for k in need
+                ]
+            for k, gl in zip(need, fitted):
+                gmms_list[k] = dict(zip(cont_idx, gl))
+                # a diverged fit is screened exactly like a bad payload
+                if not _all_finite({j: g.to_dict()
+                                    for j, g in gmms_list[k].items()}):
+                    raise ValueError(
+                        f"newcomer {k}: non-finite local GMM fit"
+                    )
+                if self.cache is not None:
+                    self.cache.store_client(fps[k], metas[k], gmms_list[k])
+        if self.cache is not None:
+            self.cache.flush_events()
+        return gmms_list
+
+    def _jsd_raw(self, metas, cat_idx) -> np.ndarray:
+        """Raw JSD of each newcomer against the FROZEN global counts."""
+        init, ob = self.init, self.init.onboarding
+        cat_cols_meta = [
+            (cursor, j) for cursor, j in enumerate(ob["cat_idx"])
+        ]
+        out = np.zeros((len(metas), len(cat_cols_meta)))
+        for r, meta in enumerate(metas):
+            for cursor, j in cat_cols_meta:
+                counts = ob["cat_counts"][cursor]
+                enc = init.encoders[cursor]
+                vec = np.zeros_like(counts)
+                for key, count in meta["columns"][j]["i2s"].items():
+                    vec[int(enc.transform([str(key)])[0])] = count
+                out[r, cursor] = _sdistance.jensenshannon(counts, vec)
+        return np.nan_to_num(out, nan=0.0)
+
+    def _wd_raw(self, gmms_list, cont_idx):
+        """Raw WD of each newcomer against the FROZEN resident pool: one
+        sketch program where residents carry the pool weights and every
+        newcomer carries omega 0 (scored, but not reshaping the pool)."""
+        from fed_tgan_tpu.federation import sketch as _sketch
+
+        ob = self.init.onboarding
+        client_gmms = [
+            [g.get(j) if isinstance(g, dict) else None for j in range(
+                max(cont_idx, default=-1) + 1)]
+            for g in gmms_list
+        ]
+        stacks_new = _sketch.stack_client_gmms(
+            client_gmms, cont_idx, n_components=N_CLUSTERS
+        )
+        means = np.concatenate([ob["mix_means"], stacks_new[0]])
+        stds = np.concatenate([ob["mix_stds"], stacks_new[1]])
+        weights = np.concatenate([ob["mix_weights"], stacks_new[2]])
+        n_res = ob["mix_means"].shape[0]
+        rows_res = np.asarray(self.init.rows_per_client, dtype=np.float64)
+        omega = np.concatenate(
+            [rows_res / rows_res.sum(), np.zeros(len(gmms_list))]
+        )
+        wd_all = _sketch.wd_sketch(
+            None, None, cont_idx, omega=omega,
+            stacks=(means, stds, weights),
+        )
+        return wd_all[n_res:], stacks_new

@@ -41,7 +41,10 @@ tenant_shed               per-tenant admission shed requests (rate-limited
 program_cost              compiled-program cost ledger entry (flops, bytes
                           accessed, peak/argument/output/temp bytes)
 init_phase                federated onboarding phase finished (phase name,
-                          seconds, client count)
+                          seconds, client count, rows)
+init_cache                encoded-shard cache outcome summary (op = hit |
+                          miss | store | corrupt, scope = client | global,
+                          count)
 serve_stages              per-stage serving latency summary (rate-limited:
                           stage means/counts since the last event)
 ========================  ====================================================
@@ -84,7 +87,7 @@ EVENT_TYPES = frozenset({
     "transport_reconnect", "transport_drop", "heartbeat_lapse",
     "compile", "backend_probe", "device_trace", "serve_reload",
     "fleet_load", "fleet_evict", "tenant_shed",
-    "program_cost", "init_phase", "serve_stages",
+    "program_cost", "init_phase", "serve_stages", "init_cache",
 })
 
 

@@ -196,16 +196,47 @@ def summarize(path: str) -> dict:
         per_phase: Dict[str, dict] = {}
         for e in inits:
             phase = str(e.get("phase", "?"))
-            d = per_phase.setdefault(phase, {"seconds": 0.0, "count": 0})
+            d = per_phase.setdefault(phase, {"seconds": 0.0, "count": 0,
+                                             "clients": 0, "rows": 0})
             d["seconds"] += float(e.get("seconds", 0) or 0)
             d["count"] += 1
+            # onboarding throughput: events carry the client/row volume
+            # that phase processed (max across events — repeated phases in
+            # one journal re-onboard the same population)
+            d["clients"] = max(d["clients"], int(e.get("clients", 0) or 0))
+            d["rows"] = max(d["rows"], int(e.get("rows", 0) or 0))
         for d in per_phase.values():
             d["seconds"] = round(d["seconds"], 3)
+            if d["seconds"] > 0:
+                if d["clients"]:
+                    d["clients_per_s"] = round(d["clients"] / d["seconds"], 1)
+                if d["rows"]:
+                    d["rows_per_s"] = round(d["rows"] / d["seconds"])
         out["init"] = {
             "total_seconds": round(sum(d["seconds"]
                                        for d in per_phase.values()), 3),
             "phases": dict(sorted(per_phase.items(),
                                   key=lambda kv: -kv[1]["seconds"])),
+        }
+
+    cache_evs = [e for e in events if e.get("type") == "init_cache"]
+    if cache_evs:
+        by_op: Dict[str, int] = {}
+        for e in cache_evs:
+            key = f"{e.get('op', '?')}_{e.get('scope', '?')}"
+            by_op[key] = by_op.get(key, 0) + int(e.get("count", 1) or 1)
+        hits = sum(n for k, n in by_op.items() if k.startswith("hit"))
+        misses = sum(n for k, n in by_op.items() if k.startswith("miss"))
+        out["init_cache"] = {
+            "by_op": dict(sorted(by_op.items())),
+            "hits": hits,
+            "misses": misses,
+            "corrupt": sum(n for k, n in by_op.items()
+                           if k.startswith("corrupt")),
+            "hit_rate": (round(hits / (hits + misses), 3)
+                         if hits + misses else None),
+            "roots": sorted({str(e.get("root")) for e in cache_evs
+                             if e.get("root")}),
         }
 
     stage_evs = [e for e in events if e.get("type") == "serve_stages"]
@@ -306,8 +337,23 @@ def render_text(summary: dict) -> str:
         lines.append(f"  init: {ini['total_seconds']}s across "
                      f"{len(ini['phases'])} phase(s)")
         for phase, d in ini["phases"].items():
+            rate = ""
+            if d.get("clients_per_s") is not None:
+                rate += f" {d['clients_per_s']:>8.1f} clients/s"
+            if d.get("rows_per_s") is not None:
+                rate += f" {d['rows_per_s']:>8d} rows/s"
             lines.append(f"    {phase:<32} {d['seconds']:>9.3f}s "
-                         f"x{d['count']}")
+                         f"x{d['count']}{rate}")
+    ic = summary.get("init_cache")
+    if ic:
+        rate = (f", hit rate {ic['hit_rate']:.1%}"
+                if ic.get("hit_rate") is not None else "")
+        corrupt = (f", {ic['corrupt']} CORRUPT entry(ies) refit"
+                   if ic.get("corrupt") else "")
+        lines.append(f"  init cache: {ic['hits']} hit(s), "
+                     f"{ic['misses']} miss(es){rate}{corrupt}")
+        for k, n in ic.get("by_op", {}).items():
+            lines.append(f"    {k:<32} {n:>9d}")
     ss = summary.get("serve_stages")
     if ss:
         lines.append("  serving stages (worst window):")

@@ -26,6 +26,10 @@ A ``FaultPlan`` describes failures to inject at exact, reproducible points:
 - ``stuck_update:rank=R[,round=E][,until=U]`` — client ``R`` replays its
   stale pre-round parameters (zero delta), the silent-failure shape the
   low-norm side of the outlier test catches.
+- ``corrupt_cache:nth=N`` — the ``N``-th init-cache entry stored in this
+  process is silently truncated after the atomic publish (default the
+  first), simulating bit-rot on the onboarding cache volume; the digest
+  manifest must catch it on the next read and force a refit.
 - ``straggle:rank=R,delay=D[,round=E][,until=U]`` — client ``R`` (1-based)
   is a scripted straggler over rounds [E, U]: under buffered aggregation
   (``TrainConfig.aggregation="buffered"``) it sits out each round's
@@ -82,14 +86,16 @@ class FaultPlan:
     straggle_delay: int = 1     # rounds the buffered delta arrives late
     straggle_round: int = 1     # first straggling round (1-based)
     straggle_until: int = 0     # last straggling round (0 = forever)
+    corrupt_cache_nth: int = 0  # 0 = no cache-corruption fault
 
-    VALID_KINDS = ("crash_checkpoint", "delay_msg", "kill_client",
-                   "nan_update", "scale_update", "sever_conn",
-                   "straggle", "stuck_update")
+    VALID_KINDS = ("corrupt_cache", "crash_checkpoint", "delay_msg",
+                   "kill_client", "nan_update", "scale_update",
+                   "sever_conn", "straggle", "stuck_update")
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
         self._save_calls = 0
+        self._cache_stores = 0
         self._severed = False
         self._killed = False
 
@@ -117,6 +123,8 @@ class FaultPlan:
                 plan.sever_after = args["after"]
             elif name == "crash_checkpoint":
                 plan.crash_save = args.get("save", 1)
+            elif name == "corrupt_cache":
+                plan.corrupt_cache_nth = int(args.get("nth", 1))
             elif name == "straggle":
                 plan.straggle_rank = int(args["rank"])
                 plan.straggle_delay = max(1, int(args.get("delay", 1)))
@@ -172,6 +180,24 @@ class FaultPlan:
             log.warning("FAULT: crashing checkpoint save #%d mid-write (%s)",
                         self.crash_save, path)
             raise FaultInjected(f"checkpoint save crashed mid-write: {path}")
+
+    def on_cache_store(self, path: str) -> bool:
+        """Called after an init-cache payload is published; truncates the
+        ``nth`` stored file in place (bit-rot, not a crash — the store
+        itself reports success).  Returns True when the fault fired."""
+        if self.corrupt_cache_nth <= 0:
+            return False
+        with self._lock:
+            self._cache_stores += 1
+            fire = self._cache_stores == self.corrupt_cache_nth
+        if not fire:
+            return False
+        log.warning("FAULT: corrupting init-cache store #%d (%s)",
+                    self.corrupt_cache_nth, path)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        return True
 
 
 def update_fault_window(

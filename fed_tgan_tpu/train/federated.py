@@ -664,7 +664,8 @@ class FederatedTrainer(RoundBookkeeping):
                                                      self.spec)
         _emit_event("init_phase", phase="shard_packing",
                     seconds=round(time.perf_counter() - t_pack, 6),
-                    clients=n_clients)
+                    clients=n_clients,
+                    rows=int(sum(m.shape[0] for m in init.client_matrices)))
         self.max_steps = int(self.steps.max())
         self.weights = np.asarray(init.weights, dtype=np.float32)
         if (self.cfg.precision == "bf16"

@@ -1,0 +1,304 @@
+"""Cohort-batched onboarding: batched fit, sketch similarity, init cache,
+fault-injected corruption, and streaming registration (ISSUE 13).
+
+Everything here runs at toy scale on CPU; the N=1024 walls live in
+``bench.py --workload onboard`` (BENCH_r13.json)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.features.bgm_jax import fit_columns_jax, fit_shards_jax
+from fed_tgan_tpu.federation import (
+    InitCache,
+    OnboardingSession,
+    federated_initialize,
+    shard_fingerprint,
+)
+from fed_tgan_tpu.obs.journal import RunJournal, read_journal, set_journal
+from fed_tgan_tpu.obs.report import render_text, summarize
+from fed_tgan_tpu.testing.faults import FaultPlan, install_plan
+
+pytestmark = pytest.mark.onboard
+
+
+@pytest.fixture(scope="module")
+def shards6(toy_frame):
+    return shard_dataframe(
+        toy_frame, 6, "dirichlet", label_column="flag", alpha=2.0, seed=13
+    )
+
+
+@pytest.fixture(scope="module")
+def clients(shards6, toy_spec):
+    return [TablePreprocessor(frame=s, **toy_spec) for s in shards6[:4]]
+
+
+@pytest.fixture(scope="module")
+def newcomers(shards6, toy_spec):
+    return [TablePreprocessor(frame=s, **toy_spec) for s in shards6[4:]]
+
+
+def _journaled_init(path, clients, **kw):
+    """Run an init under a throwaway journal; return (init, cache op counts).
+
+    The cache flushes its counters into aggregate ``init_cache`` journal
+    events at the end of every init, so the journal is the observable."""
+    journal = RunJournal(path, run_id="onboard-test")
+    prev = set_journal(journal)
+    try:
+        init = federated_initialize(clients, seed=0, backend="jax",
+                                    similarity="sketch", **kw)
+    finally:
+        set_journal(prev)
+        journal.close()
+    ops = {}
+    for e in read_journal(path):
+        if e.get("type") == "init_cache":
+            key = f"{e['op']}_{e['scope']}"
+            ops[key] = ops.get(key, 0) + int(e["count"])
+    return init, ops
+
+
+def _assert_same_init(a, b):
+    assert len(a.client_matrices) == len(b.client_matrices)
+    for ma, mb in zip(a.client_matrices, b.client_matrices):
+        assert np.array_equal(ma, mb)
+    assert np.array_equal(a.weights, b.weights)
+    assert a.output_info == b.output_info
+
+
+# --------------------------------------------------------------- batched fit
+
+
+def test_batched_fit_matches_per_client(clients):
+    solo = federated_initialize(clients, seed=0, backend="jax", batch_fit=False)
+    batched = federated_initialize(clients, seed=0, backend="jax", batch_fit=True)
+    for ma, mb in zip(solo.client_matrices, batched.client_matrices):
+        assert np.array_equal(ma, mb), "batched fit must be bitwise-identical"
+    assert np.allclose(solo.weights, batched.weights, atol=1e-9)
+
+
+def test_fit_shards_ragged_matches_fit_columns():
+    rng = np.random.default_rng(3)
+    # two shards in the same row bucket (batch composition differs from the
+    # per-client call) plus one in a smaller bucket and one degenerate
+    # tiny column that must take the host fallback
+    shard_cols = [
+        [rng.normal(0, 1, 150), rng.normal(5, 2, 150)],
+        [rng.normal(-3, 0.5, 140), rng.normal(1, 1, 140)],
+        [rng.normal(2, 1, 70)],
+        [rng.normal(0, 1, 5)],
+    ]
+    out = fit_shards_jax(shard_cols)
+    assert [len(s) for s in out] == [len(s) for s in shard_cols]
+    for shard in out:
+        for g in shard:
+            assert np.all(np.isfinite(g.means))
+            assert np.all(g.stds > 0)
+            assert np.isclose(g.weights.sum(), 1.0, atol=1e-5)
+    # bucketing independence: a shard's fit must not depend on its
+    # batch-mates, or cache entries would change meaning across cohorts
+    solo = fit_columns_jax(shard_cols[0])
+    for got, want in zip(out[0], solo):
+        assert np.array_equal(got.means, want.means)
+        assert np.array_equal(got.stds, want.stds)
+        assert np.array_equal(got.weights, want.weights)
+
+
+# ----------------------------------------------------------- sketch parity
+
+
+def test_sketch_similarity_matches_exact_weights(clients):
+    exact = federated_initialize(clients, seed=0, backend="jax",
+                                 similarity="exact")
+    sketch = federated_initialize(clients, seed=0, backend="jax",
+                                  similarity="sketch")
+    # the categorical JSD path is shared verbatim
+    assert np.allclose(exact.jsd_raw, sketch.jsd_raw)
+    # WD estimators differ (empirical Monte-Carlo vs analytic CDF grid) but
+    # the normalized scores and the downstream aggregation weights agree
+    assert np.allclose(sketch.wd.sum(axis=0), 1.0)
+    assert np.abs(exact.weights - sketch.weights).max() < 5e-3
+    assert exact.weights.argmax() == sketch.weights.argmax()
+
+
+def test_encoded_only_skips_matrices(clients):
+    init = federated_initialize(clients, seed=0, backend="jax",
+                                similarity="sketch", transform_matrices=False)
+    assert init.client_matrices == []
+    assert init.weights.shape == (4,)
+    assert np.isclose(init.weights.sum(), 1.0)
+    assert init.rows_per_client == [c.n_rows for c in clients]
+
+
+# -------------------------------------------------------------- init cache
+
+
+def test_cache_warm_run_bit_identical(clients, tmp_path):
+    root = str(tmp_path / "cache")
+    cold = federated_initialize(clients, seed=0, backend="jax",
+                                similarity="sketch", cache=root)
+    assert os.listdir(root), "cold run must populate the cache"
+    warm = federated_initialize(clients, seed=0, backend="jax",
+                                similarity="sketch", cache=root)
+    _assert_same_init(cold, warm)
+
+
+def test_cache_fingerprint_invalidation(clients, toy_spec, tmp_path):
+    kw = dict(n_components=10, backend="jax", seed=0)
+    fp0 = shard_fingerprint(clients[0], **kw)
+    assert fp0 == shard_fingerprint(clients[0], **kw)
+
+    shifted = clients[0].frame.copy()
+    shifted["score"] = shifted["score"] + 1.0
+    fp_data = shard_fingerprint(
+        TablePreprocessor(frame=shifted, **toy_spec), **kw
+    )
+    assert fp_data != fp0, "data change must change the fingerprint"
+
+    spec = dict(toy_spec)
+    spec["non_negative_columns"] = []
+    fp_schema = shard_fingerprint(
+        TablePreprocessor(frame=clients[0].frame.copy(), **spec), **kw
+    )
+    assert fp_schema != fp0, "schema knobs must change the fingerprint"
+
+    fp_seed = shard_fingerprint(clients[0], n_components=10, backend="jax",
+                                seed=1)
+    assert fp_seed != fp0
+
+    cache = InitCache(str(tmp_path / "c"))
+    assert cache.load_client(fp0) is None
+    assert cache.counts[("miss", "client")] == 1
+
+
+def test_cache_corrupt_entries_detected_and_refit(clients, tmp_path):
+    root = str(tmp_path / "cache")
+    cold = federated_initialize(clients, seed=0, backend="jax",
+                                similarity="sketch", cache=root)
+    # truncate the global npz AND one client entry: the digest check must
+    # flag both, fall back to the surviving client hits, and refit the rest
+    names = sorted(os.listdir(root))
+    victims = [n for n in names if n.startswith("global-")][:1]
+    victims += [n for n in names if n.startswith("client-")][:1]
+    assert len(victims) == 2
+    for name in victims:
+        path = os.path.join(root, name)
+        with open(path, "rb") as f:
+            blob = f.read()
+        with open(path, "wb") as f:
+            f.write(blob[: len(blob) // 2])
+
+    warm, ops = _journaled_init(str(tmp_path / "j.jsonl"), clients,
+                                cache=root)
+    _assert_same_init(cold, warm)
+    assert ops.get("corrupt_global", 0) == 1
+    assert ops.get("corrupt_client", 0) == 1
+    assert ops.get("hit_client", 0) == 3
+
+
+def test_fault_injected_cache_corruption(clients, tmp_path):
+    root = str(tmp_path / "cache")
+    try:
+        # stores land client-by-client then global: #5 is the global npz,
+        # so the warm run must detect the bad digest and fall back to the
+        # (intact) client entries
+        install_plan(FaultPlan.parse("corrupt_cache:nth=5"))
+        cold = federated_initialize(clients, seed=0, backend="jax",
+                                    similarity="sketch", cache=root)
+    finally:
+        install_plan(None)
+
+    warm, ops = _journaled_init(str(tmp_path / "j.jsonl"), clients,
+                                cache=root)
+    _assert_same_init(cold, warm)
+    assert ops.get("corrupt_global", 0) == 1
+    assert ops.get("hit_client", 0) == 4
+
+
+# ---------------------------------------------------------------- streaming
+
+
+def test_streaming_register_admits_newcomers(clients, newcomers):
+    resident = federated_initialize(clients, seed=0, backend="jax",
+                                    similarity="sketch")
+    frozen = [m.copy() for m in resident.client_matrices]
+
+    session = OnboardingSession(resident)
+    grown = session.register_clients(newcomers)
+    assert grown is session.init
+    assert session.n_clients == 6
+    assert len(grown.client_matrices) == 6
+    # residents are untouched: frozen layout, frozen encodings
+    for got, want in zip(grown.client_matrices[:4], frozen):
+        assert np.array_equal(got, want)
+    widths = {m.shape[1] for m in grown.client_matrices}
+    assert len(widths) == 1
+    assert np.isclose(grown.weights.sum(), 1.0)
+    assert grown.rows_per_client[4:] == [c.n_rows for c in newcomers]
+
+
+def test_streaming_screen_rejects_bad_shards(clients, newcomers, toy_spec):
+    resident = federated_initialize(clients, seed=0, backend="jax",
+                                    similarity="sketch")
+
+    alien = newcomers[0].frame.copy().reset_index(drop=True)
+    alien.loc[: len(alien) // 2, "color"] = "purple"  # outside frozen vocab
+    bad_vocab = TablePreprocessor(frame=alien, **toy_spec)
+
+    poisoned = newcomers[0].frame.copy().reset_index(drop=True)
+    poisoned.loc[0, "score"] = np.inf  # fails the _all_finite screen
+    bad_payload = TablePreprocessor(frame=poisoned, **toy_spec)
+
+    for bad in (bad_vocab, bad_payload):
+        with pytest.raises(ValueError):
+            OnboardingSession(resident).register_clients([bad])
+
+    # drop policy: the bad shard is skipped, the good one still lands
+    session = OnboardingSession(resident)
+    grown = session.register_clients([bad_vocab, newcomers[1]],
+                                     on_invalid="drop")
+    assert session.n_clients == 5
+    assert np.array_equal(grown.client_matrices[4],
+                          session.init.client_matrices[4])
+
+
+# ------------------------------------------------------------- observability
+
+
+def test_report_surfaces_init_rates_and_cache(clients, tmp_path):
+    root = str(tmp_path / "cache")
+    path = str(tmp_path / "journal.jsonl")
+    journal = RunJournal(path, run_id="onboard-test")
+    prev = set_journal(journal)
+    try:
+        federated_initialize(clients, seed=0, backend="jax",
+                             similarity="sketch", cache=root)
+        federated_initialize(clients, seed=0, backend="jax",
+                             similarity="sketch", cache=root)
+    finally:
+        set_journal(prev)
+        journal.close()
+
+    summary = summarize(path)
+    phases = summary["init"]["phases"]
+    assert "local_bgm_fit" in phases and "cache_restore" in phases
+    for d in phases.values():
+        if d["seconds"] > 0:
+            assert d.get("clients_per_s") is not None
+
+    ic = summary["init_cache"]
+    assert ic["by_op"]["store_client"] == 4
+    assert ic["by_op"]["hit_global"] == 1
+    assert ic["hits"] >= 1 and ic["misses"] >= 1
+    assert 0.0 < ic["hit_rate"] < 1.0
+    assert ic["roots"] == [root]
+
+    text = render_text(summary)
+    assert "init cache:" in text
+    assert "clients/s" in text
