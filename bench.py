@@ -367,7 +367,7 @@ def _setup(seed: int = 0, n_clients: int = 2, weighted: bool = True,
 
 def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
                 profile_dir: str | None = None,
-                obs_dir: str | None = "bench_obs_round",
+                obs_dir: str | None = "bench_obs/round",
                 precision: str = "f32",
                 rounds_per_program: int = 1) -> dict:
     """Seconds per round of the real server loop: every round runs the
@@ -456,6 +456,55 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
                         fused_fit(rounds)
                     writer.drain()
                     value = (time.time() - t0) / rounds
+                exporter_fig = None
+                if obs_dir:
+                    # run the same loop again with the live exporter attached
+                    # and a scraper hammering /metrics: the on/off delta
+                    # bounds the exporter's intrusion on the hot path, and
+                    # budgets.json holds it under 2% (`obs slo` gates it)
+                    import threading
+                    import urllib.request
+
+                    from fed_tgan_tpu.obs.exporter import TelemetryExporter
+
+                    lat_ms: list = []
+                    stop = threading.Event()
+                    with TelemetryExporter(port=0) as exp:
+                        def scrape():
+                            while not stop.is_set():
+                                s0 = time.time()
+                                try:
+                                    urllib.request.urlopen(
+                                        exp.url + "/metrics", timeout=5
+                                    ).read()
+                                except Exception:
+                                    pass
+                                else:
+                                    lat_ms.append((time.time() - s0) * 1e3)
+                                stop.wait(0.05)
+
+                        th = threading.Thread(target=scrape, daemon=True)
+                        th.start()
+                        t1 = time.time()
+                        if K == 1:
+                            trainer.fit(rounds, sample_hook=writer)
+                        else:
+                            fused_fit(rounds)
+                        writer.drain()
+                        on_value = (time.time() - t1) / rounds
+                        stop.set()
+                        th.join(timeout=2)
+                    lat_ms.sort()
+                    exporter_fig = {
+                        "off_s_per_round": round(value, 4),
+                        "on_s_per_round": round(on_value, 4),
+                        "overhead_frac": round(
+                            max(0.0, on_value / value - 1.0), 4),
+                        "scrapes": len(lat_ms),
+                    }
+                    if lat_ms:
+                        exporter_fig["scrape_p99_ms"] = round(
+                            lat_ms[int(0.99 * (len(lat_ms) - 1))], 2)
         result = {
             "metric": "intrusion_2client_round_seconds(train+fedavg+40k sample)"
                       + ("" if precision == "f32" else f"({precision})")
@@ -466,6 +515,8 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
             "rounds": rounds,
             "rounds_per_program": K,
         }
+        if exporter_fig is not None:
+            result["exporter"] = exporter_fig
         # device work per second: the trainer ledgers the epoch program's
         # flops on first dispatch (journal-gated), so the timed window and
         # the program's analytic cost pair up into a utilization figure
@@ -1077,7 +1128,7 @@ def bench_scale_cohort(cohort: int = 64,
                        bgm_backend: str = "jax",
                        shard_strategy: str = "iid", alpha: float = 0.5,
                        quality: bool = False,
-                       obs_dir: str | None = "bench_obs_scale") -> dict:
+                       obs_dir: str | None = "bench_obs/scale") -> dict:
     """ROADMAP item 1's thousand-client round: sweep the resident client
     population N at a FIXED per-round cohort C and show round time is
     sub-linear in N (the acceptance bar: N 64 -> 1024 grows far less than
@@ -1222,7 +1273,7 @@ def bench_onboard(populations: tuple = (64, 256, 1024),
                   comparator_populations: tuple = (64, 256),
                   encoded_only_n: int = 4096,
                   bgm_backend: str = "jax",
-                  obs_dir: str = "bench_obs_onboard") -> dict:
+                  obs_dir: str = "bench_obs/onboard") -> dict:
     """ROADMAP item 1's onboarding wall: time ``federated_initialize``
     alone over the population sweep, with per-phase host attribution.
 
@@ -1912,7 +1963,7 @@ def main() -> int:
     ap.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                     help="round workload: capture a jax.profiler trace of "
                          "the measured rounds into DIR")
-    ap.add_argument("--obs-dir", type=str, default="bench_obs_round",
+    ap.add_argument("--obs-dir", type=str, default="bench_obs/round",
                     metavar="DIR",
                     help="round workload: write telemetry artifacts into "
                          "DIR — journal.jsonl (run journal), trace.json "
@@ -2169,8 +2220,8 @@ def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
     if args.workload == "onboard":
         return bench_onboard(
             bgm_backend=bgm,
-            obs_dir=(args.obs_dir if args.obs_dir != "bench_obs_round"
-                     else "bench_obs_onboard"))
+            obs_dir=(args.obs_dir if args.obs_dir != "bench_obs/round"
+                     else "bench_obs/onboard"))
     if args.workload == "scale":
         if args.cohort:
             return bench_scale_cohort(

@@ -26,6 +26,7 @@ utility_analysis.py work unchanged:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import csv
 import os
 import pickle
@@ -294,6 +295,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --sanitize semantics plus jax_debug_nans: "
                         "raise at the op that produced the first NaN")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                   help="serve live telemetry from inside the training "
+                        "process: /metrics (Prometheus), /healthz (round "
+                        "progress, watchdog, quarantine census), /journal "
+                        "(NDJSON, ?follow=1 tails).  0 picks a free port.  "
+                        "Implies a run journal (see --journal); in "
+                        "multihost mode rank r binds PORT+r")
+    p.add_argument("--journal", type=str, default=None, metavar="PATH",
+                   help="write a run journal (JSONL event stream) to PATH "
+                        "(default with --obs-port: <out-dir>/journal.jsonl, "
+                        "suffixed _rank<N> in multihost mode); read it "
+                        "back with `python -m fed_tgan_tpu.obs report/watch`")
     # reference-compatible world bookkeeping (ignored in SPMD mode)
     p.add_argument("-rank", "--rank", type=int, default=None)
     p.add_argument("-world_size", "--world_size", type=int, default=None)
@@ -610,6 +623,58 @@ def _enable_compile_cache() -> None:
         print(f"note: persistent compile cache disabled ({exc})")
 
 
+@contextlib.contextmanager
+def _observability(args):
+    """Opt-in live-observability plane around one training dispatch.
+
+    ``--journal PATH`` installs the process-wide run journal; ``--obs-port``
+    additionally starts the in-trainer HTTP exporter (and implies a journal
+    at ``<out-dir>/journal.jsonl``).  In a reference-style multihost launch
+    every rank is its own process, so rank r binds PORT+r and writes
+    ``..._rank<r>.jsonl`` — ``obs report j_rank*.jsonl`` merges the streams
+    back into one federation view.  Everything drains in ``finally`` so a
+    ``/journal?follow=1`` tail sees a complete stream even on crash.
+    """
+    jpath = args.journal
+    if jpath is None and args.obs_port is not None:
+        jpath = os.path.join(args.out_dir, "journal.jsonl")
+    rank = args.rank
+    if jpath is not None and rank is not None and args.ip:
+        root, ext = os.path.splitext(jpath)
+        jpath = f"{root}_rank{rank}{ext or '.jsonl'}"
+    journal = exporter = None
+    try:
+        if jpath is not None:
+            from fed_tgan_tpu.obs.journal import RunJournal, set_journal
+
+            os.makedirs(os.path.dirname(os.path.abspath(jpath)), exist_ok=True)
+            journal = RunJournal(jpath)
+            set_journal(journal)
+        if args.obs_port is not None:
+            from fed_tgan_tpu.obs.exporter import TelemetryExporter, get_health
+
+            port = args.obs_port
+            if port and rank is not None and args.ip:
+                port += rank
+            get_health().update(status="starting")
+            exporter = TelemetryExporter(port=port).start()
+            if not args.quiet:
+                print(f"obs: live telemetry on {exporter.url} "
+                      f"(/metrics /healthz /journal); journal -> {jpath}")
+        yield
+    finally:
+        if exporter is not None:
+            from fed_tgan_tpu.obs.exporter import get_health
+
+            get_health().update(status="finished")
+            exporter.shutdown()
+        if journal is not None:
+            from fed_tgan_tpu.obs.journal import set_journal
+
+            set_journal(None)
+            journal.close()
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -685,7 +750,8 @@ def main(argv=None) -> int:
         # transport; training itself is one SPMD program per mesh slice.
         # Client ranks need only ip/port/rank; the server also needs
         # world_size to know how many joins to wait for.
-        return _run_multihost_init(args)
+        with _observability(args):
+            return _run_multihost_init(args)
     if args.rank == 0 and args.ip and not args.world_size:
         print("multihost rank 0 needs -world_size (how many clients to wait for)")
         return 2
@@ -755,7 +821,9 @@ def main(argv=None) -> int:
                       "pass --datapath/--client-data to evaluate a resumed run")
         if not args.quiet:
             print(f"resumed from {ckpt_src} at round {trainer.completed_epochs}")
-        return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
+        with _observability(args):
+            return _run_training(args, name, kwargs, trainer, init, frames,
+                                 ckpt_dir)
 
     t_init = time.time()
     if args.client_data:
@@ -804,7 +872,8 @@ def main(argv=None) -> int:
     if args.mode == "standalone":
         # no participants, no harmonization/refit protocol — skip the
         # federated construction entirely
-        return _run_standalone(args, name, kwargs, frames, columns, cfg)
+        with _observability(args):
+            return _run_standalone(args, name, kwargs, frames, columns, cfg)
     clients = [
         TablePreprocessor(frame=f, name=name, selected_columns=columns, **kwargs)
         for f in frames
@@ -830,7 +899,9 @@ def main(argv=None) -> int:
         trainer = FederatedTrainer(init, config=cfg, seed=args.seed,
                                    min_clients=args.min_clients or 1,
                                    quarantine_strikes=args.quarantine_strikes)
-    return _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir)
+    with _observability(args):
+        return _run_training(args, name, kwargs, trainer, init, frames,
+                             ckpt_dir)
 
 
 def _run_sample_from(args) -> int:
@@ -1021,7 +1092,10 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
             snapshot(e, tr)
         if mon_due(e):
             m = monitor.evaluate(tr, seed=args.seed + e)
-            mon_log.append(e, m["avg_jsd"], m["avg_wd"])
+            mon_log.append(e, m["avg_jsd"], m["avg_wd"],
+                           extra={k: m[k] for k in
+                                  ("per_column_jsd", "per_column_wd")
+                                  if k in m})
             if not args.quiet:
                 print(
                     f"round {e}: Avg_JSD={m['avg_jsd']:.4f} "

@@ -900,6 +900,105 @@ def check_observability() -> bool:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def check_observatory(timeout: int = 300) -> bool:
+    """The live observation plane observes a real instrumented run.
+
+    A subprocess (backend init must stay out of the doctor process) runs
+    two federated rounds on a 2-device virtual mesh with the run journal
+    installed and the in-trainer HTTP exporter bound to an ephemeral
+    port, then scrapes itself: ``/metrics`` must carry the per-client
+    contribution ledger series (``fed_tgan_client_weight{client=...}``),
+    ``/healthz`` must report the training round progress, and
+    ``/journal`` must stream one ``client_contribution`` event per
+    round -- the live plane end-to-end, not just its parts."""
+    import json
+    import subprocess
+
+    code = (
+        "import json\n"
+        "import tempfile\n"
+        "import urllib.request\n"
+        "from fed_tgan_tpu.parallel.mesh import (client_mesh,\n"
+        "                                        provision_virtual_cpu)\n"
+        "provision_virtual_cpu(2)\n"
+        "import numpy as np\n"
+        "import pandas as pd\n"
+        "from fed_tgan_tpu.data.ingest import TablePreprocessor\n"
+        "from fed_tgan_tpu.data.sharding import shard_dataframe\n"
+        "from fed_tgan_tpu.federation.init import federated_initialize\n"
+        "from fed_tgan_tpu.obs.exporter import TelemetryExporter, get_health\n"
+        "from fed_tgan_tpu.obs.journal import RunJournal, set_journal\n"
+        "from fed_tgan_tpu.train.federated import FederatedTrainer\n"
+        "from fed_tgan_tpu.train.steps import TrainConfig\n"
+        "rng = np.random.default_rng(7)\n"
+        "n = 240\n"
+        "frame = pd.DataFrame({\n"
+        "    'amount': np.exp(rng.normal(2.0, 1.0, n)).round(2),\n"
+        "    'color': rng.choice(['red', 'green', 'blue'], n)})\n"
+        "shards = shard_dataframe(frame, 2, 'iid', seed=9)\n"
+        "clients = [TablePreprocessor(frame=s, name='doctor',\n"
+        "                             categorical_columns=['color'],\n"
+        "                             non_negative_columns=['amount'])\n"
+        "           for s in shards]\n"
+        "init = federated_initialize(clients, seed=0)\n"
+        "cfg = TrainConfig(embedding_dim=8, gen_dims=(16, 16),\n"
+        "                  dis_dims=(16, 16), batch_size=40, pac=4)\n"
+        "tr = FederatedTrainer(init, config=cfg, mesh=client_mesh(2),\n"
+        "                      seed=0)\n"
+        "with tempfile.TemporaryDirectory() as td:\n"
+        "    journal = RunJournal(td + '/journal.jsonl', run_id='doctor')\n"
+        "    set_journal(journal)\n"
+        "    with TelemetryExporter(port=0) as exp:\n"
+        "        tr.fit(2)\n"
+        "        get = lambda p: urllib.request.urlopen(\n"
+        "            exp.url + p, timeout=10).read().decode()\n"
+        "        metrics, tail = get('/metrics'), get('/journal')\n"
+        "        health = json.loads(get('/healthz'))\n"
+        "    set_journal(None)\n"
+        "    journal.close()\n"
+        "print(json.dumps({\n"
+        "    'weight_series': 'fed_tgan_client_weight{' in metrics,\n"
+        "    'strike_series': 'fed_tgan_client_strikes{' in metrics,\n"
+        "    'health_round': health.get('round'),\n"
+        "    'health_status': health.get('status'),\n"
+        "    'contrib_events': sum(1 for l in tail.splitlines()\n"
+        "                          if '\"client_contribution\"' in l)}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "observatory", f"timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return _line(False, "observatory",
+                     " | ".join(tail) or "instrumented run failed")
+    try:
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        return _line(False, "observatory", f"unparseable result: {exc!r}")
+    if not res.get("weight_series") or not res.get("strike_series"):
+        return _line(False, "observatory",
+                     "/metrics is missing the per-client ledger series "
+                     "(fed_tgan_client_weight / fed_tgan_client_strikes)")
+    if res.get("health_status") != "training" or res.get("health_round") != 1:
+        return _line(False, "observatory",
+                     f"/healthz wrong: status={res.get('health_status')!r} "
+                     f"round={res.get('health_round')!r} (want training/1)")
+    if res.get("contrib_events") != 2:
+        return _line(False, "observatory",
+                     f"/journal streamed {res.get('contrib_events')} "
+                     "client_contribution events for 2 rounds")
+    return _line(True, "observatory",
+                 "live exporter scraped mid-run: per-client ledger on "
+                 "/metrics, round progress on /healthz, 2 "
+                 "client_contribution events on /journal")
+
+
 def check_cost_ledger(timeout: int = 300) -> bool:
     """The device cost ledger reports real figures and the SLO gate
     accepts the repo's own checked-in bench records.
@@ -1018,6 +1117,7 @@ def main(argv=None) -> int:
         check_cohort_scale(),
         check_onboarding(),
         check_observability(),
+        check_observatory(),
         check_cost_ledger(),
         check_serving(),
         check_serving_fleet(),

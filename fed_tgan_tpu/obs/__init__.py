@@ -12,10 +12,14 @@ Three legs, one package, zero heavy imports:
   local-steps, aggregate, snapshot, monitor, checkpoint) can be overlaid
   on the XLA device timeline from ``runtime/profiling.py``.
 - :mod:`.journal` -- a durable per-run JSONL event stream (round
-  summaries, watchdog alarms and rollbacks, quarantine / eviction,
-  transport reconnects and heartbeat lapses, compile events, backend
-  probes, checkpoints) with a stable schema, summarized by
-  ``python -m fed_tgan_tpu.obs report <journal>``.
+  summaries, per-client contributions, watchdog alarms and rollbacks,
+  quarantine / eviction, transport reconnects and heartbeat lapses,
+  compile events, backend probes, checkpoints) with a stable schema,
+  summarized by ``python -m fed_tgan_tpu.obs report <journal>...``.
+- :mod:`.exporter` -- the live plane: an opt-in in-trainer HTTP
+  exporter (``--obs-port``) serving ``/metrics``, ``/healthz`` and the
+  journal as tailable NDJSON, watched live by
+  ``python -m fed_tgan_tpu.obs watch``.
 
 Everything here is pure stdlib and MUST stay importable before
 jax / numpy warm up -- ``doctor.py --check observability`` enforces it.
@@ -26,6 +30,11 @@ regions stay clean under ``jax.transfer_guard_device_to_host``.
 
 from __future__ import annotations
 
+from fed_tgan_tpu.obs.exporter import (
+    HealthState,
+    TelemetryExporter,
+    get_health,
+)
 from fed_tgan_tpu.obs.journal import (
     RunJournal,
     emit,
@@ -51,12 +60,15 @@ from fed_tgan_tpu.obs.trace import (
 __all__ = [
     "Counter",
     "Gauge",
+    "HealthState",
     "Histogram",
     "MetricsRegistry",
     "RunJournal",
+    "TelemetryExporter",
     "Tracer",
     "current_tracer",
     "emit",
+    "get_health",
     "get_journal",
     "get_registry",
     "read_journal",

@@ -2,21 +2,26 @@
 
 Subcommands:
 
-- ``report <journal.jsonl> [--format text|json]`` -- summarize a run
-  journal (rounds, watchdog, robustness, transport, compiles,
-  checkpoints, program costs, init phases, serving stages).
+- ``report <journal.jsonl>... [--format text|json]`` -- summarize one
+  or more run journals (rounds, clients, watchdog, robustness,
+  transport, compiles, checkpoints, program costs, init phases,
+  serving stages); several journals (a multihost run's per-rank
+  streams) merge into one federation view keyed by round.
 - ``slo <bench-or-journal> [--budgets FILE]`` -- SLO regression gate:
   check a bench record / journal against checked-in budgets.  Exit 1
   on a regression, 0 on pass (stale-budget improvements warn), 2 on
   malformed input/budgets.
+- ``watch <journal|url>... [--follow]`` -- live terminal view over
+  journal files or a training process's ``--obs-port`` exporter, with
+  the SLO gate re-evaluated every K rounds as an in-run alarm.
 - ``ledger [--json] [--family F]`` -- compile the hlolint-contracted
   programs and print their device cost ledger.  This subcommand (and
   only this one) imports jax.
 
-Exit codes: 0 ok, 1 SLO regression, 2 usage / unreadable input.  The
-module itself stays pure stdlib at import time -- ``report`` and
-``slo`` never import jax; ``ledger`` imports it lazily inside the
-handler.
+Exit codes: 0 ok, 1 SLO regression/breach, 2 usage / unreadable input.
+The module itself stays pure stdlib at import time -- ``report``,
+``slo`` and ``watch`` never import jax; ``ledger`` imports it lazily
+inside the handler.
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ import argparse
 
 from fed_tgan_tpu.obs.report import report_main
 from fed_tgan_tpu.obs.slo import slo_main
+from fed_tgan_tpu.obs.watch import watch_main
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -33,14 +39,36 @@ def build_parser() -> argparse.ArgumentParser:
         description="run-journal tooling for fed_tgan_tpu telemetry",
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
-    rep = sub.add_parser("report", help="summarize a run journal (JSONL)")
-    rep.add_argument("journal", help="path to the journal JSONL file")
+    rep = sub.add_parser(
+        "report", help="summarize run journal(s) (JSONL; multihost "
+                       "per-rank journals merge into one view)")
+    rep.add_argument("journal", nargs="+",
+                     help="path(s) to journal JSONL file(s)")
     rep.add_argument("--format", choices=("text", "json"), default="text")
     slo = sub.add_parser(
         "slo", help="check a bench record or journal against SLO budgets")
     slo.add_argument("input", help="bench record JSON or journal JSONL")
     slo.add_argument("--budgets", default=None,
                      help="budget file (default: packaged obs/budgets.json)")
+    wat = sub.add_parser(
+        "watch", help="live view: tail journal file(s) or poll an "
+                      "--obs-port exporter URL")
+    wat.add_argument("source", nargs="+",
+                     help="journal JSONL path(s) or http://host:port of a "
+                          "training exporter")
+    wat.add_argument("--follow", action="store_true",
+                     help="keep tailing until interrupted (default: one "
+                          "pass over what exists now)")
+    wat.add_argument("--interval", type=float, default=1.0,
+                     help="poll interval in seconds (default 1)")
+    wat.add_argument("--slo-every", type=int, default=25,
+                     help="re-evaluate SLO budgets every K observed "
+                          "rounds (default 25)")
+    wat.add_argument("--budgets", default=None,
+                     help="budget file (default: packaged obs/budgets.json)")
+    wat.add_argument("--max-seconds", type=float, default=None,
+                     help="stop following after this many seconds "
+                          "(testing/automation)")
     led = sub.add_parser(
         "ledger", help="compile contracted programs, print the cost ledger")
     led.add_argument("--json", action="store_true",
@@ -56,6 +84,8 @@ def main(argv=None) -> int:
         return report_main(args.journal, fmt=args.format)
     if args.cmd == "slo":
         return slo_main(args)
+    if args.cmd == "watch":
+        return watch_main(args)
     if args.cmd == "ledger":
         # lazy: the ledger pass compiles programs, so only it pulls jax
         from fed_tgan_tpu.obs.ledger import ledger_main

@@ -47,6 +47,13 @@ init_cache                encoded-shard cache outcome summary (op = hit |
                           count)
 serve_stages              per-stage serving latency summary (rate-limited:
                           stage means/counts since the last event)
+client_contribution       per-round per-client ledger (parallel arrays keyed
+                          by global client id: weights, losses, quarantine
+                          mask, strikes) from the one gated metrics pull
+similarity                monitor probe sample (epoch, avg_jsd, avg_wd and,
+                          when available, per-column values)
+slo_breach                live SLO re-evaluation flagged a budget regression
+                          (rule name, figure, bound) -- emitted by obs watch
 ========================  ====================================================
 
 Writers go through a process-wide current journal: ``set_journal``
@@ -88,6 +95,7 @@ EVENT_TYPES = frozenset({
     "compile", "backend_probe", "device_trace", "serve_reload",
     "fleet_load", "fleet_evict", "tenant_shed",
     "program_cost", "init_phase", "serve_stages", "init_cache",
+    "client_contribution", "similarity", "slo_breach",
 })
 
 
@@ -172,17 +180,26 @@ def emit(type: str, **fields) -> Optional[dict]:
     return j.emit(type, **fields)
 
 
-def read_journal(path: str) -> Iterator[dict]:
-    """Yield parsed events; tolerates blank and truncated tail lines."""
+def read_journal(path: str, on_skip=None) -> Iterator[dict]:
+    """Yield parsed events; tolerates blank and truncated tail lines.
+
+    ``on_skip``, when given, is called with a one-line description for
+    every undecodable line (a crashed writer leaves a torn final line);
+    CLI readers route it to stderr, library readers stay silent.
+    """
     with open(path) as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 event = json.loads(line)
             except json.JSONDecodeError:
-                continue  # torn tail write on crash -- skip, don't die
+                # torn tail write on crash -- skip, don't die
+                if on_skip is not None:
+                    on_skip(f"{path}:{lineno}: skipping truncated journal "
+                            f"line ({len(line)} bytes)")
+                continue
             if isinstance(event, dict):
                 yield event
 

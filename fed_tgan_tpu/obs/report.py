@@ -10,23 +10,181 @@ are the checkpoints.  Text by default, ``--format json`` for tooling
 from __future__ import annotations
 
 import json
-from typing import Dict, List
+import sys
+from typing import Dict, List, Sequence, Union
 
 from fed_tgan_tpu.obs.journal import read_journal
 
-__all__ = ["summarize", "render_text"]
+__all__ = ["summarize", "summarize_many", "render_text"]
 
 
-def summarize(path: str) -> dict:
+def _clients_section(contribs: List[dict], quarantines: List[dict],
+                     alarms: List[dict], rollbacks: List[dict],
+                     drops: List[dict]) -> dict:
+    """Fold ``client_contribution`` events into one per-round client table.
+
+    Order-independent across merged rank journals: rows are keyed by
+    (round, client) and folded in sorted order, so merging ``[a, b]``
+    and ``[b, a]`` produces identical output.
+    """
+    rows = []
+    for ev in contribs:
+        rnd = ev.get("round")
+        ids = ev.get("clients") or []
+        if not isinstance(rnd, int):
+            continue
+
+        def col(name, default=None):
+            v = ev.get(name)
+            return v if isinstance(v, list) and len(v) == len(ids) else None
+
+        weights, ld, lg = col("weights"), col("loss_d"), col("loss_g")
+        quar, strikes = col("quarantined"), col("strikes")
+        for j, c in enumerate(ids):
+            rows.append((
+                int(rnd), int(c),
+                weights[j] if weights else None,
+                ld[j] if ld else None,
+                lg[j] if lg else None,
+                int(quar[j]) if quar else 0,
+                int(strikes[j]) if strikes else 0,
+            ))
+    rows.sort(key=lambda r: (r[0], r[1], str(r[2:])))
+    table: Dict[int, Dict[int, tuple]] = {}
+    for r in rows:
+        table.setdefault(r[0], {})[r[1]] = r[2:]
+
+    per_client: Dict[str, dict] = {}
+    track: Dict[int, dict] = {}
+    for rnd in sorted(table):
+        for c, (w, ld, lg, q, s) in sorted(table[rnd].items()):
+            d = track.setdefault(c, {
+                "rounds": 0, "first_round": rnd, "weight_first": w,
+                "quarantined_rounds": 0, "strikes": 0,
+            })
+            d["rounds"] += 1
+            d["last_round"] = rnd
+            if d["weight_first"] is None:
+                d["weight_first"] = w
+            if w is not None:
+                d["weight_last"] = w
+            d["loss_d_last"], d["loss_g_last"] = ld, lg
+            d["quarantined_rounds"] += q
+            d["strikes"] = max(d["strikes"], s)
+    dropped_by = {int(e["client"]): str(e.get("reason", "")) for e in drops
+                  if e.get("client") is not None}
+    for c in sorted(track):
+        d = track[c]
+        wf, wl = d.get("weight_first"), d.get("weight_last")
+        d["weight_delta"] = (round(wl - wf, 6)
+                             if wf is not None and wl is not None else None)
+        if c in dropped_by:
+            d["dropped"] = dropped_by[c] or True
+        per_client[str(c)] = d
+
+    movers = sorted(
+        ((c, d["weight_delta"]) for c, d in per_client.items()
+         if d.get("weight_delta") is not None),
+        key=lambda kv: (-abs(kv[1]), kv[0]))
+    forensics = []
+    wd_events = sorted(
+        [("alarm", e) for e in alarms] + [("rollback", e) for e in rollbacks],
+        key=lambda kv: kv[1].get("round", 0) if isinstance(
+            kv[1].get("round"), int) else 0)
+    for ev in quarantines:
+        c = ev.get("client")
+        if c is None:
+            continue
+        first = ev.get("first")
+        entry = {
+            "client": int(c),
+            "first": first,
+            "last": ev.get("last"),
+            "rounds": ev.get("rounds"),
+            "test": ev.get("test", "?"),
+            "strikes": ev.get("strikes"),
+        }
+        # what the watchdog did next: the first alarm/rollback at or
+        # after the quarantine window opened
+        nxt = next((f"{kind}@{we.get('round')}"
+                    + (f" ({we.get('reason')})" if we.get("reason") else "")
+                    for kind, we in wd_events
+                    if isinstance(we.get("round"), int)
+                    and isinstance(first, int)
+                    and we.get("round") >= first), None)
+        if nxt:
+            entry["watchdog"] = nxt
+        if int(c) in dropped_by:
+            entry["dropped"] = dropped_by[int(c)] or True
+        forensics.append(entry)
+    forensics.sort(key=lambda f: (f.get("first") or 0, f["client"]))
+
+    return {
+        "tracked": len(per_client),
+        "rounds": len(table),
+        "per_client": per_client,
+        "top_movers": movers[:5],
+        "forensics": forensics,
+    }
+
+
+def _similarity_section(sims: List[dict]) -> dict:
+    """Drift as a first-class signal: the monitor probe's trajectory."""
+    samples = [e for e in sims if isinstance(e.get("avg_jsd"), (int, float))]
+    out: dict = {"samples": len(sims)}
+    if samples:
+        epochs = [e.get("epoch") for e in samples
+                  if isinstance(e.get("epoch"), int)]
+        out["first_epoch"] = min(epochs) if epochs else None
+        out["last_epoch"] = max(epochs) if epochs else None
+        last = samples[-1]
+        out["avg_jsd_last"] = round(float(last["avg_jsd"]), 6)
+        out["avg_jsd_best"] = round(
+            min(float(e["avg_jsd"]) for e in samples), 6)
+        if isinstance(last.get("avg_wd"), (int, float)):
+            out["avg_wd_last"] = round(float(last["avg_wd"]), 6)
+        per_col = last.get("per_column_jsd")
+        if isinstance(per_col, dict) and per_col:
+            worst = sorted(per_col.items(),
+                           key=lambda kv: (-float(kv[1]), kv[0]))
+            out["per_column_jsd_last"] = {
+                k: round(float(v), 6) for k, v in sorted(per_col.items())}
+            out["worst_columns"] = [
+                [k, round(float(v), 6)] for k, v in worst[:3]]
+    return out
+
+
+def summarize(path: str, on_skip=None) -> dict:
     """Structured summary of one journal file."""
-    events = list(read_journal(path))
+    return summarize_many([path], on_skip=on_skip)
+
+
+def summarize_many(paths: Sequence[str], on_skip=None) -> dict:
+    """One merged federation view over one or more journals.
+
+    A multihost run writes one journal per rank; merging keys everything
+    by round.  Per-rank duplicates of the round stream (every rank logs
+    its own ``round`` events) are deduplicated deterministically: the
+    server stream wins when present, else the lowest rank.  Per-client
+    streams (``client_contribution``) union across ranks -- each rank
+    contributes its own clients.  ``on_skip`` receives a warning line
+    per torn/truncated journal line (crashed writer) instead of raising.
+    """
+    events: List[dict] = []
+    for path in paths:
+        events.extend(read_journal(path, on_skip=on_skip))
+    # stable ts-sort: merged rank streams interleave in wall order, ties
+    # keep per-journal append order (determinism for identical ts)
+    events.sort(key=lambda ev: (ev.get("ts") if isinstance(
+        ev.get("ts"), (int, float)) else 0.0))
     by_type: Dict[str, int] = {}
     for ev in events:
         t = str(ev.get("type", "?"))
         by_type[t] = by_type.get(t, 0) + 1
 
     out: dict = {
-        "path": str(path),
+        "path": ",".join(str(p) for p in paths),
+        "paths": [str(p) for p in paths],
         "events": len(events),
         "by_type": dict(sorted(by_type.items())),
         "schema": None,
@@ -44,6 +202,19 @@ def summarize(path: str) -> dict:
             out["duration_s"] = round(max(ts) - min(ts), 3)
 
     rounds = [e for e in events if e.get("type") == "round"]
+    # multihost rank streams: every rank emits its own round events; a
+    # merged view must count each round once.  The server's stream is
+    # canonical when present, else the lowest-ranked client's.
+    roles = {str(e.get("role")) for e in rounds if e.get("role")}
+    if roles:
+        if "server" in roles:
+            rounds = [e for e in rounds if e.get("role") == "server"]
+        else:
+            ranks = sorted(int(e.get("rank", 0)) for e in rounds
+                           if e.get("rank") is not None)
+            if ranks:
+                rounds = [e for e in rounds
+                          if int(e.get("rank", 0)) == ranks[0]]
     if rounds:
         per = [e["per_round_s"] for e in rounds
                if isinstance(e.get("per_round_s"), (int, float))]
@@ -110,6 +281,15 @@ def summarize(path: str) -> dict:
             "clients_dropped": sorted({e.get("client") for e in drops
                                        if e.get("client") is not None}),
         }
+
+    contribs = [e for e in events if e.get("type") == "client_contribution"]
+    if contribs:
+        out["clients"] = _clients_section(contribs, quarantines,
+                                          alarms, rollbacks, drops)
+
+    sims = [e for e in events if e.get("type") == "similarity"]
+    if sims:
+        out["similarity"] = _similarity_section(sims)
 
     flaps = [e for e in events
              if e.get("type") in ("transport_reconnect", "transport_drop",
@@ -298,6 +478,47 @@ def render_text(summary: dict) -> str:
     if rb:
         lines.append(f"  robustness: {rb['quarantine_events']} quarantine "
                      f"event(s), dropped clients {rb['clients_dropped']}")
+    cl = summary.get("clients")
+    if cl:
+        lines.append(f"  clients: {cl['tracked']} tracked over "
+                     f"{cl['rounds']} round(s)")
+        for c, d in cl.get("per_client", {}).items():
+            wf, wl = d.get("weight_first"), d.get("weight_last")
+            traj = (f"weight {wf:.4f}->{wl:.4f}"
+                    if wf is not None and wl is not None else "weight n/a")
+            extra = ""
+            if d.get("quarantined_rounds"):
+                extra += (f", {d['quarantined_rounds']} quarantined "
+                          f"round(s), {d['strikes']} strike(s)")
+            if d.get("dropped"):
+                extra += " [DROPPED]"
+            lines.append(f"    client {c}: {traj}, "
+                         f"{d['rounds']} round(s){extra}")
+        if cl.get("top_movers"):
+            movers = ", ".join(f"client {c} {delta:+.4f}"
+                               for c, delta in cl["top_movers"])
+            lines.append(f"    top movers: {movers}")
+        for f in cl.get("forensics", []):
+            tail = ""
+            if f.get("watchdog"):
+                tail += f" -> watchdog {f['watchdog']}"
+            if f.get("dropped"):
+                tail += f" -> dropped ({f['dropped']})"
+            lines.append(
+                f"    forensics: client {f['client']} quarantined rounds "
+                f"{f.get('first')}..{f.get('last')} "
+                f"(test={f.get('test')}, strikes={f.get('strikes')}){tail}")
+    sim = summary.get("similarity")
+    if sim and sim.get("avg_jsd_last") is not None:
+        wd = (f" avg_wd {sim['avg_wd_last']}"
+              if sim.get("avg_wd_last") is not None else "")
+        lines.append(f"  similarity: {sim['samples']} sample(s), epochs "
+                     f"{sim.get('first_epoch')}..{sim.get('last_epoch')}, "
+                     f"avg_jsd last {sim['avg_jsd_last']} "
+                     f"(best {sim['avg_jsd_best']}){wd}")
+        if sim.get("worst_columns"):
+            worst = ", ".join(f"{k}={v}" for k, v in sim["worst_columns"])
+            lines.append(f"    worst columns (jsd): {worst}")
     tr = summary.get("transport")
     if tr:
         lines.append(f"  transport: {tr['reconnects']} reconnect(s), "
@@ -367,11 +588,16 @@ def render_text(summary: dict) -> str:
     return "\n".join(lines)
 
 
-def report_main(path: str, fmt: str = "text") -> int:
+def report_main(path: Union[str, Sequence[str]], fmt: str = "text") -> int:
+    paths = [path] if isinstance(path, str) else list(path)
+
+    def warn(msg: str) -> None:
+        print(f"obs report: warning: {msg}", file=sys.stderr)
+
     try:
-        summary = summarize(path)
+        summary = summarize_many(paths, on_skip=warn)
     except OSError as exc:
-        print(f"obs report: cannot read {path}: {exc}")
+        print(f"obs report: cannot read {paths}: {exc}")
         return 2
     if fmt == "json":
         print(json.dumps(summary, indent=2, default=str))

@@ -42,9 +42,11 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["check_slo", "default_budgets_path", "load_budgets", "slo_main"]
+__all__ = ["check_slo", "check_figures", "default_budgets_path",
+           "load_budgets", "slo_main"]
 
 #: improvement thresholds that flag a budget as stale (overridable
 #: per-rule): a value under ``stale_frac * max`` or over
@@ -114,7 +116,11 @@ def _load_input(path: str) -> Tuple[str, object]:
         try:
             ev = json.loads(line)
         except json.JSONDecodeError:
-            continue  # torn tail line
+            # torn tail line (crashed writer): skip with a warning, the
+            # remaining events are still a valid gate input
+            print(f"slo: warning: {path}: skipping truncated journal line "
+                  f"({len(line)} bytes)", file=sys.stderr)
+            continue
         if isinstance(ev, dict) and "type" in ev:
             events.append(ev)
     if not events:
@@ -204,6 +210,28 @@ def _check_rule(rule: dict, value: float, where: str,
     return reg, stale
 
 
+def check_figures(figures: Dict[str, float], rules: List[dict],
+                  where: str = "journal"
+                  ) -> Tuple[int, int, int, List[str]]:
+    """Evaluate budget rules against an already-folded figure map.
+
+    The live half of the gate: ``obs watch`` re-folds the journal every
+    K rounds and calls this in memory, without re-reading budgets or
+    touching disk.  Returns ``(regressions, stale, matched, lines)``.
+    """
+    lines: List[str] = []
+    regressions = stale = matched = 0
+    for rule in rules:
+        value = figures.get(rule["metric"])
+        if value is None:
+            continue
+        matched += 1
+        r, s = _check_rule(rule, value, where, lines)
+        regressions += r
+        stale += s
+    return regressions, stale, matched, lines
+
+
 def check_slo(input_path: str, budgets_path: str) -> Tuple[int, List[str]]:
     """Check one input against the budget file.
 
@@ -232,14 +260,11 @@ def check_slo(input_path: str, budgets_path: str) -> Tuple[int, List[str]]:
                 stale += s
     else:
         figures = journal_figures(payload)  # type: ignore[arg-type]
-        for rule in rules:
-            value = figures.get(rule["metric"])
-            if value is None:
-                continue
-            matched += 1
-            r, s = _check_rule(rule, value, "journal", lines)
-            regressions += r
-            stale += s
+        r, s, m, rule_lines = check_figures(figures, rules)
+        regressions += r
+        stale += s
+        matched += m
+        lines.extend(rule_lines)
     if not matched:
         lines.append(f"warning: no budget rule matched {input_path!r} "
                      f"({kind} input, {len(rules)} rules)")

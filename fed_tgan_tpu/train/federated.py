@@ -29,8 +29,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fed_tgan_tpu.analysis.sanitizers import hot_region
+from fed_tgan_tpu.obs.exporter import get_health
 from fed_tgan_tpu.obs.journal import emit as _emit_event, get_journal
-from fed_tgan_tpu.obs.registry import counter as _metric_counter
+from fed_tgan_tpu.obs.registry import counter as _metric_counter, get_registry
 from fed_tgan_tpu.obs.trace import span as _span
 from fed_tgan_tpu.federation.init import FederatedInit, renormalize_weights
 from fed_tgan_tpu.ops.segments import SegmentSpec
@@ -773,6 +774,87 @@ class FederatedTrainer(RoundBookkeeping):
         except Exception:  # noqa: BLE001 -- obs must never kill training
             pass
 
+    # labeled registry series are bounded: beyond this many clients the
+    # ledger lives in the journal only (labels stay scrape-friendly)
+    _LEDGER_LABEL_CAP = 64
+
+    def _publish_round_obs(self, e: int, size: int, metrics_host,
+                           per_round_s: float, ok: bool) -> None:
+        """Per-client contribution ledger + live health, from host state.
+
+        Everything here reads values ALREADY on host: the one gated
+        ``device_get`` of the chunk's metrics, ``self.weights`` /
+        ``self._strikes`` (host numpy), and host clocks.  Called outside
+        the hot region; adds zero device->host transfers.  Emits one
+        ``client_contribution`` journal event per LOGICAL round (chunk-head
+        convention, like round/aggregate) and refreshes the bounded
+        labeled registry series the exporter serves at /metrics.
+        """
+        n_live = self.n_clients - len(self.dropped_clients)
+        health_fields = dict(
+            status="training",
+            round=int(e + size - 1),
+            rounds_per_s=(round(1.0 / per_round_s, 3)
+                          if per_round_s > 0 else None),
+            per_round_s=round(per_round_s, 6),
+            finite=bool(ok),
+            population=int(self.n_clients),
+            live_clients=int(n_live),
+            dropped_clients=sorted(int(i) for i in self.dropped_clients),
+            strikes_total=int(self._strikes.sum()),
+            clients_with_strikes=int((self._strikes > 0).sum()),
+        )
+        if isinstance(metrics_host, dict) and "cohort" in metrics_host:
+            health_fields["cohort_size"] = int(
+                np.asarray(metrics_host["cohort"]).shape[-1])
+        get_health().update(**health_fields)
+        if get_journal() is None or not isinstance(metrics_host, dict) \
+                or "loss_g" not in metrics_host:
+            return
+        try:
+            loss_d = np.asarray(metrics_host.get("loss_d"), dtype=np.float64)
+            loss_g = np.asarray(metrics_host["loss_g"], dtype=np.float64)
+            quar = metrics_host.get("quarantined")
+            cohort = metrics_host.get("cohort")
+
+            def _num(x):
+                return round(float(x), 6) if np.isfinite(x) else None
+
+            ids = None
+            for r in range(size):
+                ei = e + r
+                if cohort is not None:
+                    ids = np.asarray(cohort)[r].astype(int)
+                else:
+                    ids = np.arange(self.n_clients)
+                qrow = (np.asarray(quar)[r] > 0.5 if quar is not None
+                        else np.zeros(ids.size, dtype=bool))
+                _emit_event(
+                    "client_contribution", round=ei, first=e,
+                    rounds_per_program=size,
+                    clients=[int(i) for i in ids],
+                    weights=[_num(self.weights[i]) for i in ids],
+                    loss_d=[_num(v) for v in loss_d[r]],
+                    loss_g=[_num(v) for v in loss_g[r]],
+                    quarantined=[int(b) for b in qrow],
+                    strikes=[int(self._strikes[i]) for i in ids],
+                )
+            # registry: last round's view, one labeled series per client
+            reg = get_registry()
+            for i in ids:
+                i = int(i)
+                if i >= self._LEDGER_LABEL_CAP:
+                    continue
+                lab = {"client": str(i)}
+                reg.gauge("fed_tgan_client_weight",
+                          "similarity aggregation weight",
+                          labels=lab).set(float(self.weights[i]))
+                reg.gauge("fed_tgan_client_strikes",
+                          "quarantine strikes accumulated",
+                          labels=lab).set(float(self._strikes[i]))
+        except Exception:  # noqa: BLE001 -- obs must never kill training
+            pass
+
     def drop_client(self, idx: int, reason: str = "") -> None:
         """Drop client ``idx`` (0-based) from all future rounds.
 
@@ -1070,10 +1152,14 @@ class FederatedTrainer(RoundBookkeeping):
             # per chunk instead of one per np.asarray (jaxlint J01)
             log_due = bool(log_every) and any(
                 ei % log_every == 0 for ei in range(e, e + size))
+            # the contribution ledger rides this same single EXPLICIT
+            # transfer (guard-legal under the sanitizer) -- an installed
+            # journal opts the chunk into the pull, never adds a second one
             need_host = (
                 not ok
                 or health_cb is not None
                 or log_due
+                or get_journal() is not None
                 or (isinstance(metrics, dict)
                     and ("quarantined" in metrics or "cohort" in metrics))
             )
@@ -1099,7 +1185,30 @@ class FederatedTrainer(RoundBookkeeping):
                     import logging
 
                     logg = logging.getLogger("fed_tgan_tpu.train")
+                    # forensics: name the gate screen that tripped.  The
+                    # gate runs two tests in-graph (non-finite delta, norm
+                    # outlier); host-side we see losses, not deltas, so the
+                    # inference is: non-finite losses => the client truly
+                    # diverged ("nonfinite"), finite losses => the delta
+                    # was screened on magnitude ("norm_outlier").  A NaN
+                    # delta under finite losses reports as norm_outlier --
+                    # indistinguishable without new program outputs, which
+                    # the hlolint contracts forbid.
+                    losses = np.stack([
+                        np.asarray(metrics_host[k_], dtype=np.float64)
+                        for k_ in ("loss_d", "loss_g") if k_ in metrics_host
+                    ]) if any(k_ in metrics_host
+                              for k_ in ("loss_d", "loss_g")) else None
                     for idx in np.nonzero(counts)[0]:
+                        if "cohort" in metrics_host:
+                            sel = q & (ids == idx)
+                        else:
+                            sel = np.zeros_like(q)
+                            sel[:, idx] = q[:, idx]
+                        tripped = "norm_outlier"
+                        if losses is not None and sel.any() and \
+                                not np.isfinite(losses[:, sel]).all():
+                            tripped = "nonfinite"
                         logg.warning(
                             "update gate quarantined client %d for %d of "
                             "rounds %d..%d (strikes %d/%d)",
@@ -1110,7 +1219,8 @@ class FederatedTrainer(RoundBookkeeping):
                             "quarantine", client=int(idx),
                             rounds=int(counts[idx]), first=e,
                             last=e + size - 1,
-                            strikes=int(self._strikes[idx]))
+                            strikes=int(self._strikes[idx]),
+                            test=tripped)
                     # evict repeat offenders (clean RuntimeError below the
                     # min_clients floor); survivors' weights renormalize
                     for idx in np.nonzero(
@@ -1184,6 +1294,7 @@ class FederatedTrainer(RoundBookkeeping):
                         buffered_applied=self._buffered_applied,
                         staleness=stale_hist,
                     )
+            self._publish_round_obs(e, size, metrics_host, per_round, ok)
             if log_due:
                 m = jax.tree.map(lambda x: np.asarray(x).mean(),
                                  metrics_host)

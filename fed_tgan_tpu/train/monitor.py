@@ -71,6 +71,8 @@ class SimilarityMonitor:
 
         self._cats = []   # (col_idx, p_real (K,))
         self._conts = []  # (col_idx, lo, span, sorted_real_scaled (n_rows,), is_log)
+        self._cat_names = []   # column names, parallel to _cats
+        self._cont_names = []  # column names, parallel to _conts
         for i, col in enumerate(meta.columns):
             name = col.name
             vals = real_frame[name]
@@ -79,6 +81,7 @@ class SimilarityMonitor:
                 codes = enc.transform(vals.astype(str).to_numpy())
                 p = np.bincount(codes, minlength=len(enc)).astype(np.float64)
                 self._cats.append((i, jnp.asarray(p / p.sum(), jnp.float32)))
+                self._cat_names.append(name)
             else:
                 import pandas as pd
 
@@ -91,6 +94,7 @@ class SimilarityMonitor:
                 self._conts.append(
                     (i, lo, span, jnp.asarray(sample, jnp.float32), name in nonneg)
                 )
+                self._cont_names.append(name)
         self._programs = {}
 
     # ------------------------------------------------------------ core fn
@@ -121,6 +125,13 @@ class SimilarityMonitor:
         out = {}
         out["avg_jsd"] = jnp.stack(jsds).mean() if jsds else jnp.float32(jnp.nan)
         out["avg_wd"] = jnp.stack(wds).mean() if wds else jnp.float32(jnp.nan)
+        # per-column values ride the same program outputs (the probe is
+        # NOT an hlolint-contracted program) so drift is attributable to
+        # a column, not just the mean -- a handful of extra scalars
+        if jsds:
+            out["jsd_cols"] = jnp.stack(jsds)
+        if wds:
+            out["wd_cols"] = jnp.stack(wds)
         return out
 
     # ------------------------------------------------- fused trainer probe
@@ -145,14 +156,26 @@ class SimilarityMonitor:
 
     def evaluate(self, trainer, seed: int = 0) -> dict:
         """Generate n_rows with the trainer's current aggregated generator
-        and return {'avg_jsd': float, 'avg_wd': float} — two scalars of
-        host traffic."""
+        and return {'avg_jsd': float, 'avg_wd': float} plus
+        ``per_column_jsd`` / ``per_column_wd`` name->value dicts — one
+        batched transfer of a handful of scalars of host traffic."""
         params_g, state_g = trainer._global_model()
         out = self._program(trainer)(
             params_g, state_g, trainer.server_cond, jax.random.key(seed + 31)
         )
-        # one batched transfer for both scalars (jaxlint J01)
-        return {k: float(v) for k, v in jax.device_get(out).items()}
+        # one batched transfer for all scalars (jaxlint J01)
+        host = jax.device_get(out)
+        res = {"avg_jsd": float(host["avg_jsd"]),
+               "avg_wd": float(host["avg_wd"])}
+        if "jsd_cols" in host:
+            res["per_column_jsd"] = {
+                name: float(v)
+                for name, v in zip(self._cat_names, host["jsd_cols"])}
+        if "wd_cols" in host:
+            res["per_column_wd"] = {
+                name: float(v)
+                for name, v in zip(self._cont_names, host["wd_cols"])}
+        return res
 
 
 class MonitorLog:
@@ -173,7 +196,8 @@ class MonitorLog:
         self._file = None
         self._writer = None
 
-    def append(self, epoch: int, avg_jsd: float, avg_wd: float) -> None:
+    def append(self, epoch: int, avg_jsd: float, avg_wd: float,
+               extra: dict | None = None) -> None:
         import csv
         import os
 
@@ -185,6 +209,14 @@ class MonitorLog:
                 self._writer.writerow(self.HEADER)
         self._writer.writerow([epoch, avg_jsd, avg_wd])
         self._file.flush()
+        # mirror the row into the run journal (no-op without one) so
+        # Avg_JSD/Avg_WD trajectories show up in `obs report` without the
+        # CSV; the CSV above stays byte-identical -- `extra` (per-column
+        # values, rank tags) goes only to the journal
+        from fed_tgan_tpu.obs.journal import emit as _emit_event
+
+        _emit_event("similarity", epoch=int(epoch), avg_jsd=float(avg_jsd),
+                    avg_wd=float(avg_wd), **(extra or {}))
 
     def close(self) -> None:
         if self._file is not None:

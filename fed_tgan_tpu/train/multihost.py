@@ -33,7 +33,8 @@ import numpy as np
 
 import jax
 
-from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.exporter import get_health
+from fed_tgan_tpu.obs.journal import emit as _emit_event, get_journal
 from fed_tgan_tpu.obs.registry import counter as _metric_counter
 from fed_tgan_tpu.obs.trace import span as _span
 from fed_tgan_tpu.ops.segments import SegmentSpec
@@ -216,6 +217,59 @@ class _OrderedSender(AsyncWorker):
         if parts_finish is not None:
             msg["snapshot_parts"] = parts_finish()
         self.transport.send_obj(msg)
+
+
+def _publish_rank_obs(rank: int, client: int, first: int, size: int,
+                      metrics, weights, seconds: float) -> None:
+    """Per-rank live observability after a chunk syncs.
+
+    Emits one ``client_contribution`` journal event per LOGICAL round
+    covering THIS rank's client (``obs report`` merges the per-rank
+    streams into the federation-wide table, keyed by round) and
+    refreshes the rank's /healthz fields.  Reads only the chunk's
+    already-synced local metric shards -- host numpy, no collective, no
+    extra device program.  Journal-gated; never raises into training.
+    """
+    per_round_s = seconds / max(1, size)
+    get_health().update(
+        status="training", role="client", rank=int(rank),
+        client=int(client), round=int(first + size - 1),
+        per_round_s=round(per_round_s, 6),
+        rounds_per_s=(round(1.0 / per_round_s, 3) if per_round_s > 0
+                      else None))
+    if get_journal() is None or not isinstance(metrics, dict):
+        return
+    try:
+        host = {}
+        for k, v in metrics.items():
+            host[k] = np.asarray(
+                v.addressable_shards[0].data
+                if hasattr(v, "addressable_shards") else v)
+        lg = host.get("loss_g")
+        if lg is None:
+            return
+        lg = lg.reshape(size, -1)
+        ld = host.get("loss_d")
+        ld = ld.reshape(size, -1) if ld is not None else None
+        qu = host.get("quarantined")
+        qu = qu.reshape(size, -1) if qu is not None else None
+
+        def _num(x):
+            return round(float(x), 6) if np.isfinite(x) else None
+
+        for r in range(size):
+            _emit_event(
+                "client_contribution", round=int(first + r),
+                first=int(first), rounds_per_program=int(size),
+                rank=int(rank), clients=[int(client)],
+                weights=[round(float(weights[client]), 6)],
+                loss_d=[_num(ld[r, 0])] if ld is not None else [None],
+                loss_g=[_num(lg[r, 0])],
+                quarantined=[int(qu[r, 0] > 0.5)] if qu is not None else [0],
+                strikes=[0],
+            )
+    except Exception:  # noqa: BLE001 -- obs must never kill training
+        pass
 
 
 def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun) -> dict:
@@ -465,6 +519,8 @@ def client_train(transport, init_out: dict, cfg: TrainConfig, run: MultihostRun)
             _emit_event("round", role="client", rank=transport.rank,
                         first=e, last=last, rounds=size,
                         per_round_s=round(seconds / size, 6))
+            _publish_rank_obs(transport.rank, c, e, size, metrics, weights,
+                              seconds)
 
             if sender is not None:
                 # rank 1 is the reporting participant: post-psum state is
@@ -619,6 +675,11 @@ def server_train(
                         first=msg["last"] - msg["rounds"] + 1,
                         last=msg["last"], rounds=msg["rounds"],
                         per_round_s=round(per_round, 6))
+            get_health().update(
+                status="training", role="server", rank=0,
+                round=int(msg["last"]), per_round_s=round(per_round, 6),
+                rounds_per_s=(round(1.0 / per_round, 3) if per_round > 0
+                              else None))
             snap = msg.get("snapshot_parts")
             for i in range(msg["rounds"]):
                 ei = msg["last"] - msg["rounds"] + 1 + i

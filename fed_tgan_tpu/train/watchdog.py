@@ -28,6 +28,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from fed_tgan_tpu.obs.exporter import get_health
 from fed_tgan_tpu.obs.journal import emit as _emit_event
 from fed_tgan_tpu.obs.registry import counter as _metric_counter
 
@@ -198,6 +199,11 @@ def fit_with_watchdog(
             _emit_event("watchdog_alarm", reason=str(alarm),
                         round=int(trainer.completed_epochs),
                         rollbacks=watchdog.rollbacks)
+            # live /healthz: alarm state is host-side bookkeeping only
+            get_health().update(
+                watchdog_last_alarm=str(alarm),
+                watchdog_alarm_round=int(trainer.completed_epochs),
+                watchdog_rollbacks=watchdog.rollbacks)
             log.warning("watchdog alarm (%s); rollback %d/%d",
                         alarm, watchdog.rollbacks,
                         watchdog.cfg.max_rollbacks)
@@ -240,6 +246,10 @@ def fit_with_watchdog(
             _emit_event("watchdog_rollback", restored_from=str(src),
                         round=int(trainer.completed_epochs),
                         generation_skip=gen_skip, lr=float(trainer.cfg.lr))
+            get_health().update(
+                watchdog_rollbacks=watchdog.rollbacks,
+                watchdog_restored_round=int(trainer.completed_epochs),
+                lr=float(trainer.cfg.lr))
             log.warning(
                 "rolled back to %s (round %d); lr re-annealed %g -> %g",
                 src, trainer.completed_epochs, old_lr, trainer.cfg.lr,

@@ -1,0 +1,559 @@
+"""Live federation observatory: exporter, contribution ledger, merge.
+
+The live plane's tier-1 gates: the in-trainer HTTP exporter serves
+/metrics, /healthz and a tailable /journal without adding a single
+device->host transfer (sanitizer-armed); the per-client contribution
+ledger lands in the journal and the labeled registry series; torn
+journal tails are tolerated by every reader; and per-rank multihost
+journals merge into one deterministic federation view.
+"""
+
+import argparse
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.obs import (
+    HealthState,
+    RunJournal,
+    TelemetryExporter,
+    get_health,
+    get_registry,
+    read_journal,
+    set_journal,
+)
+from fed_tgan_tpu.obs.report import render_text, summarize, summarize_many
+from fed_tgan_tpu.obs.watch import watch_main
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_uninstalled():
+    """Tests must not leak a process-wide journal or health fields."""
+    yield
+    set_journal(None)
+    get_health().reset()
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+# ------------------------------------------------------ exporter lifecycle
+
+
+def test_exporter_lifecycle_and_endpoints(tmp_path):
+    """start() binds an ephemeral port; /metrics serves the registry,
+    /healthz the health snapshot, /journal the NDJSON file with the
+    offset handshake; shutdown() makes the port refuse."""
+    jpath = str(tmp_path / "run.jsonl")
+    with RunJournal(jpath, run_id="lifecycle") as j:
+        j.emit("round", first=0, last=0, rounds=1, per_round_s=0.5)
+    health = HealthState()
+    health.update(status="training", round=7)
+    reg = get_registry()
+    reg.counter("obsv_lifecycle_total", "test counter").inc(3)
+
+    exp = TelemetryExporter(port=0, journal_path=jpath, health=health)
+    with exp:
+        assert exp.port != 0
+        metrics = _get(exp.url + "/metrics").decode()
+        assert "obsv_lifecycle_total 3" in metrics
+
+        snap = json.loads(_get(exp.url + "/healthz"))
+        assert snap["status"] == "training" and snap["round"] == 7
+        assert "uptime_s" in snap
+
+        with urllib.request.urlopen(exp.url + "/journal", timeout=10) as r:
+            body = r.read().decode()
+            offset = int(r.headers["X-Journal-Offset"])
+        lines = [json.loads(ln) for ln in body.splitlines()]
+        assert [e["type"] for e in lines] == ["run_start", "round", "run_end"]
+        assert offset == os.path.getsize(jpath)
+        # incremental poll from the returned offset: nothing new
+        with urllib.request.urlopen(
+                f"{exp.url}/journal?offset={offset}", timeout=10) as r:
+            assert r.read() == b""
+
+        with pytest.raises(urllib.error.HTTPError):
+            _get(exp.url + "/nope")
+    with pytest.raises(OSError):
+        _get(exp.url + "/metrics", timeout=1.0)
+
+
+def test_exporter_journal_falls_back_to_installed(tmp_path):
+    """Without an explicit journal_path the exporter serves whatever
+    journal is currently installed process-wide (the CLI wiring)."""
+    with TelemetryExporter(port=0) as exp:
+        with pytest.raises(urllib.error.HTTPError):  # 404: none installed
+            _get(exp.url + "/journal")
+        j = RunJournal(str(tmp_path / "late.jsonl"), run_id="late")
+        set_journal(j)
+        try:
+            body = _get(exp.url + "/journal").decode()
+            assert '"run_start"' in body
+        finally:
+            set_journal(None)
+            j.close()
+
+
+def test_journal_follow_streams_concurrent_writes(tmp_path):
+    """?follow=1 tail-streams lines appended AFTER the request started,
+    and the stream terminates when the exporter drains."""
+    jpath = str(tmp_path / "follow.jsonl")
+    journal = RunJournal(jpath, run_id="follow")
+    set_journal(journal)
+    exp = TelemetryExporter(port=0, journal_path=jpath).start()
+    got: list = []
+    done = threading.Event()
+
+    def reader():
+        with urllib.request.urlopen(
+                exp.url + "/journal?follow=1", timeout=30) as resp:
+            buf = b""
+            while True:
+                chunk = resp.read(1)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    got.append(json.loads(line))
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        for i in range(20):
+            journal.emit("round", first=i, last=i, rounds=1)
+            time.sleep(0.005)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sum(1 for e in got if e.get("type") == "round") >= 20:
+                break
+            time.sleep(0.05)
+        rounds = [e["first"] for e in got if e.get("type") == "round"]
+        assert rounds == list(range(20)), rounds
+    finally:
+        exp.shutdown()  # draining=True ends the follow stream
+        set_journal(None)
+        journal.close()
+    assert done.wait(timeout=10), "follow stream did not terminate on drain"
+
+
+# ------------------------------------------------- crash-tolerant readers
+
+
+def _torn_journal(tmp_path) -> str:
+    path = str(tmp_path / "torn.jsonl")
+    with RunJournal(path, run_id="torn") as j:
+        j.emit("round", first=0, last=0, rounds=1, per_round_s=0.25)
+        j.emit("round", first=1, last=1, rounds=1, per_round_s=0.25)
+    # hand-truncate mid-line, like a crashed writer: chop the run_end
+    # event after its first 20 bytes
+    with open(path, "r") as fh:
+        lines = fh.readlines()
+    with open(path, "w") as fh:
+        fh.writelines(lines[:-1])
+        fh.write(lines[-1][:20])
+    return path
+
+
+def test_report_skips_truncated_tail_with_warning(tmp_path, capsys):
+    path = _torn_journal(tmp_path)
+    warnings: list = []
+    s = summarize(path, on_skip=warnings.append)
+    assert s["events"] == 3  # run_start + 2 rounds; torn run_end skipped
+    assert s["rounds"]["total_rounds"] == 2
+    assert len(warnings) == 1 and "truncated journal line" in warnings[0]
+    # the CLI path surfaces the warning on stderr and still exits 0
+    from fed_tgan_tpu.obs.report import report_main
+
+    assert report_main(path, fmt="json") == 0
+    err = capsys.readouterr().err
+    assert "obs report: warning" in err and "truncated" in err
+
+
+def test_slo_skips_truncated_tail_with_warning(tmp_path, capsys):
+    from fed_tgan_tpu.obs.slo import check_slo, default_budgets_path
+
+    path = _torn_journal(tmp_path)
+    code, lines = check_slo(path, default_budgets_path())
+    assert code == 0  # nothing matched, but the input parsed
+    assert "truncated journal line" in capsys.readouterr().err
+
+
+def test_watch_skips_truncated_tail_with_warning(tmp_path, capsys):
+    path = _torn_journal(tmp_path)
+    args = argparse.Namespace(source=[path], follow=False, interval=0.05,
+                              slo_every=25, budgets=None, max_seconds=None)
+    assert watch_main(args) == 0
+    out, err = capsys.readouterr()
+    assert "truncated journal line" in err
+    assert "[watch] round 1 (2 seen)" in out
+
+
+# --------------------------------------------------------- watch + live SLO
+
+
+def test_watch_breach_alerts_and_lands_in_journal(tmp_path, capsys):
+    """A budget regression observed live prints an ALERT and appends an
+    slo_breach event to the watched journal; exit code goes 1."""
+    path = str(tmp_path / "run.jsonl")
+    with RunJournal(path, run_id="breach") as j:
+        j.emit("round", first=0, last=0, rounds=1, per_round_s=0.5)
+        j.emit("program_cost", name="toy_prog", family="toy",
+               flops=5000, bytes_accessed=10, peak_bytes=10)
+    budgets = tmp_path / "budgets.json"
+    budgets.write_text(json.dumps({"schema": 1, "budgets": [
+        {"name": "toy-flops-ceiling", "metric": "program/toy_prog/flops",
+         "max": 1000.0}]}))
+    args = argparse.Namespace(source=[path], follow=False, interval=0.05,
+                              slo_every=1, budgets=str(budgets),
+                              max_seconds=None)
+    assert watch_main(args) == 1
+    out = capsys.readouterr().out
+    assert "ALERT REGRESSION toy-flops-ceiling" in out
+    assert "slo BREACH" in out
+    breaches = [e for e in read_journal(path) if e["type"] == "slo_breach"]
+    assert len(breaches) == 1
+    assert breaches[0]["rules"] == ["toy-flops-ceiling"]
+
+    # the landed event is part of the journal now: report sees it too
+    assert summarize(path)["by_type"]["slo_breach"] == 1
+
+
+def test_watch_polls_exporter_url(tmp_path, capsys):
+    """URL sources read /journal?offset=N incrementally."""
+    jpath = str(tmp_path / "url.jsonl")
+    journal = RunJournal(jpath, run_id="url")
+    set_journal(journal)
+    exp = TelemetryExporter(port=0, journal_path=jpath).start()
+    try:
+        journal.emit("round", first=0, last=0, rounds=1, per_round_s=0.2)
+        args = argparse.Namespace(source=[exp.url], follow=False,
+                                  interval=0.05, slo_every=25, budgets=None,
+                                  max_seconds=None)
+        assert watch_main(args) == 0
+        assert "[watch] round 0 (1 seen)" in capsys.readouterr().out
+    finally:
+        exp.shutdown()
+        set_journal(None)
+        journal.close()
+
+
+# ------------------------------------------------- multi-rank journal merge
+
+
+def _rank_journals(tmp_path):
+    """Synthesize a 2-rank multihost run: a server stream plus one
+    journal per client rank, each carrying its own round events and its
+    own client's contributions."""
+    paths = []
+    for rank, client in ((0, None), (1, 0), (2, 1)):
+        path = str(tmp_path / f"journal_rank{rank}.jsonl")
+        with RunJournal(path, run_id="mh") as j:
+            for rnd in range(3):
+                if rank == 0:
+                    j.emit("round", first=rnd, last=rnd, rounds=1,
+                           role="server", per_round_s=0.5)
+                else:
+                    j.emit("round", first=rnd, last=rnd, rounds=1,
+                           role="client", rank=rank, per_round_s=0.6)
+                    j.emit("client_contribution", round=rnd, first=rnd,
+                           rounds_per_program=1, rank=rank,
+                           clients=[client], weights=[0.5],
+                           loss_d=[-0.1 * (client + 1)],
+                           loss_g=[0.2 * (client + 1)],
+                           quarantined=[0], strikes=[0])
+        paths.append(path)
+    return paths
+
+
+def test_multirank_merge_is_order_independent(tmp_path):
+    paths = _rank_journals(tmp_path)
+
+    def normalized(ps):
+        s = summarize_many(ps)
+        s.pop("path"), s.pop("paths")
+        return s
+
+    forward = normalized(paths)
+    backward = normalized(list(reversed(paths)))
+    assert forward == backward
+
+
+def test_multirank_merge_one_federation_view(tmp_path):
+    paths = _rank_journals(tmp_path)
+    s = summarize_many(paths)
+    # per-rank round streams dedup to the server's: 3 rounds, not 9
+    assert s["rounds"]["total_rounds"] == 3
+    # client contributions union across ranks into one per-round table
+    cl = s["clients"]
+    assert cl["tracked"] == 2 and cl["rounds"] == 3
+    assert set(cl["per_client"]) == {"0", "1"}
+    for c in ("0", "1"):
+        assert cl["per_client"][c]["rounds"] == 3
+        assert cl["per_client"][c]["weight_last"] == 0.5
+    assert cl["per_client"]["1"]["loss_g_last"] == pytest.approx(0.4)
+    text = render_text(s)
+    assert "clients: 2 tracked over 3 round(s)" in text
+
+
+def test_merge_without_server_prefers_lowest_rank(tmp_path):
+    paths = _rank_journals(tmp_path)[1:]  # client ranks only
+    s = summarize_many(paths)
+    assert s["rounds"]["total_rounds"] == 3  # rank 1's stream, not both
+
+
+# ------------------------- contribution ledger: trainer integration + d2h
+
+
+@pytest.fixture(scope="module")
+def fed_init2(toy_frame, toy_spec):
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.init import federated_initialize
+
+    shards = shard_dataframe(toy_frame, 2, "iid", seed=9)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    return federated_initialize(clients, seed=0)
+
+
+def _small_cfg():
+    from fed_tgan_tpu.train.steps import TrainConfig
+
+    return TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                       batch_size=40, pac=4)
+
+
+def test_contribution_ledger_rides_the_gated_pull_zero_d2h(
+        fed_init2, tmp_path):
+    """Sanitizer-armed gate for the ledger AND the live exporter: with
+    the device->host transfer guard up, one journaled round must emit
+    per-round client_contribution events, refresh the labeled registry
+    series, and answer live scrapes -- the only transfer is the
+    trainer's one explicit (guard-legal) metrics pull."""
+    from fed_tgan_tpu.analysis import sanitizers
+    from fed_tgan_tpu.analysis.sanitizers import sanitize
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+
+    tr = FederatedTrainer(fed_init2, config=_small_cfg(),
+                          mesh=client_mesh(2), seed=0)
+    jpath = str(tmp_path / "gate.jsonl")
+    scrapes: list = []
+    try:
+        with sanitize():
+            tr.fit(2)  # warmup: hot_region first entry is unguarded
+
+            journal = RunJournal(jpath, run_id="gate")
+            set_journal(journal)
+            with TelemetryExporter(port=0, journal_path=jpath) as exp:
+                tr.fit(2)  # guarded: any ADDED d2h raises here
+                scrapes.append(_get(exp.url + "/metrics").decode())
+                scrapes.append(_get(exp.url + "/healthz").decode())
+            set_journal(None)
+            journal.close()
+    finally:
+        sanitizers.disable_sanitizers()
+
+    contribs = [e for e in read_journal(jpath)
+                if e["type"] == "client_contribution"]
+    assert [e["round"] for e in contribs] == [2, 3]
+    for ev in contribs:
+        assert ev["clients"] == [0, 1]
+        assert len(ev["weights"]) == 2
+        assert ev["quarantined"] == [0, 0] and ev["strikes"] == [0, 0]
+        assert all(isinstance(v, float) for v in ev["loss_d"])
+        assert all(isinstance(v, float) for v in ev["loss_g"])
+    np.testing.assert_allclose(sum(contribs[-1]["weights"]), 1.0, atol=1e-4)
+
+    metrics, health = scrapes[0], json.loads(scrapes[1])
+    for c in ("0", "1"):
+        assert f'fed_tgan_client_weight{{client="{c}"}}' in metrics
+        assert f'fed_tgan_client_strikes{{client="{c}"}}' in metrics
+    assert health["status"] == "training"
+    assert health["round"] == 3 and health["live_clients"] == 2
+
+    # the merged report builds the client table from this journal alone
+    cl = summarize(jpath)["clients"]
+    assert cl["tracked"] == 2 and cl["rounds"] == 2
+
+
+def test_no_journal_means_no_ledger_and_no_extra_pull(fed_init2):
+    """Without a journal the chunk never opts into the metrics pull for
+    ledger purposes and no client series appear -- the flag-off path is
+    byte-for-byte the old behavior."""
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+
+    tr = FederatedTrainer(fed_init2, config=_small_cfg(),
+                          mesh=client_mesh(2), seed=0)
+    tr.fit(1)
+    health = get_health().snapshot()
+    assert health["status"] == "training"  # health is journal-independent
+    assert health["population"] == 2
+
+
+# ------------------------------------------------ quarantine forensics
+
+
+@pytest.fixture(scope="module")
+def fed_init3(toy_frame, toy_spec):
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.init import federated_initialize
+
+    shards = shard_dataframe(toy_frame, 3, "iid", seed=9)
+    clients = [TablePreprocessor(frame=s, **toy_spec) for s in shards]
+    return federated_initialize(clients, seed=0)
+
+
+def test_quarantine_forensics_name_client_round_and_test(
+        fed_init3, tmp_path):
+    """ISSUE acceptance: an injected scale_update fault shows up in
+    `obs report` forensics naming the client, the quarantine window,
+    and the tripped test."""
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.testing.faults import FaultPlan, install_plan
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+
+    jpath = str(tmp_path / "faulty.jsonl")
+    install_plan(FaultPlan.parse("scale_update:factor=1000,rank=2"))
+    try:
+        tr = FederatedTrainer(fed_init3, config=_small_cfg(),
+                              mesh=client_mesh(3), seed=0, min_clients=1,
+                              quarantine_strikes=2)
+        with RunJournal(jpath, run_id="faulty") as j:
+            set_journal(j)
+            try:
+                tr.fit(3, max_rounds_per_call=1)
+            finally:
+                set_journal(None)
+    finally:
+        install_plan(None)
+
+    assert tr.dropped_clients == {1}
+    s = summarize(jpath)
+    forensics = s["clients"]["forensics"]
+    assert forensics, "no quarantine forensics produced"
+    for f in forensics:
+        assert f["client"] == 1
+        assert f["test"] == "norm_outlier"  # scaled-but-finite update
+        assert isinstance(f["first"], int)
+    assert any(f.get("dropped") for f in forensics)
+    # the ledger rows carry the quarantine bit for the same client
+    per = s["clients"]["per_client"]["1"]
+    assert per["quarantined_rounds"] >= 1 and per["strikes"] >= 1
+    text = render_text(s)
+    assert "forensics: client 1" in text and "test=norm_outlier" in text
+
+
+# -------------------------------------------------- monitor -> journal
+
+
+def test_monitorlog_csv_byte_identical_and_similarity_event(tmp_path):
+    from fed_tgan_tpu.train.monitor import MonitorLog
+
+    plain = tmp_path / "plain.csv"
+    with MonitorLog(str(plain)) as log:
+        log.append(0, 0.5, 0.125)
+        log.append(2, 0.25, 0.0625)
+
+    journaled = tmp_path / "journaled.csv"
+    jpath = str(tmp_path / "mon.jsonl")
+    with RunJournal(jpath, run_id="mon") as j:
+        set_journal(j)
+        try:
+            with MonitorLog(str(journaled)) as log:
+                log.append(0, 0.5, 0.125)
+                log.append(2, 0.25, 0.0625,
+                           extra={"per_column_jsd": {"color": 0.3}})
+        finally:
+            set_journal(None)
+
+    # CSV stays byte-identical with or without a journal (and with extra)
+    assert plain.read_bytes() == journaled.read_bytes()
+    sims = [e for e in read_journal(jpath) if e["type"] == "similarity"]
+    assert [e["epoch"] for e in sims] == [0, 2]
+    assert sims[1]["per_column_jsd"] == {"color": 0.3}
+
+    sim = summarize(jpath)["similarity"]
+    assert sim["samples"] == 2
+    assert sim["avg_jsd_last"] == 0.25 and sim["avg_jsd_best"] == 0.25
+    assert sim["worst_columns"] == [["color", 0.3]]
+
+
+# ------------------------------------------- multihost end-to-end (slow)
+
+
+@pytest.mark.slow
+def test_multihost_journals_merge_into_one_client_table(tmp_path):
+    """A real 2-client multihost run with --journal writes one journal
+    per rank; `obs report` over the merged streams produces one
+    per-round client table covering both clients with the server's
+    round stream counted once."""
+    import subprocess
+    import sys
+
+    import pandas as pd
+
+    rng = np.random.default_rng(3)
+    n = 360
+    df = pd.DataFrame({
+        "amount": rng.normal(10, 3, n),
+        "color": rng.choice(["red", "green", "blue"], n, p=[0.5, 0.3, 0.2]),
+    })
+    paths = []
+    per = n // 2
+    for i in range(2):
+        p = tmp_path / f"shard{i}.csv"
+        df.iloc[i * per:(i + 1) * per].to_csv(p, index=False)
+        paths.append(str(p))
+
+    port = 23000 + os.getpid() % 2000
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    base = [
+        sys.executable, "-m", "fed_tgan_tpu.cli",
+        "--dataset", "custom", "--categorical", "color",
+        "-world_size", "3", "-ip", "127.0.0.1", "-port", str(port),
+        "--backend", "cpu", "--out-dir", str(tmp_path),
+        "-epochs", "3", "--sample-every", "2", "--sample-rows", "64",
+        "--batch-size", "40", "--embedding-dim", "16", "--seed", "0",
+        "--journal", str(tmp_path / "journal.jsonl"),
+    ]
+    procs = [
+        subprocess.Popen(
+            base + ["-rank", str(r), "--datapath", paths[max(r - 1, 0)]],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for r in (0, 1, 2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+
+    rank_paths = [str(tmp_path / f"journal_rank{r}.jsonl") for r in (0, 1, 2)]
+    for p in rank_paths:
+        assert os.path.exists(p), p
+    s = summarize_many(rank_paths)
+    assert s["rounds"]["total_rounds"] == 3
+    cl = s["clients"]
+    assert set(cl["per_client"]) == {"0", "1"}
+    for c in ("0", "1"):
+        assert cl["per_client"][c]["rounds"] == 3
+        assert cl["per_client"][c]["weight_last"] == pytest.approx(0.5,
+                                                                   abs=0.01)
+    # merge order must not matter (the operator globs the files)
+    alt = summarize_many(list(reversed(rank_paths)))
+    assert alt["clients"] == cl
