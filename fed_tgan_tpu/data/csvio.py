@@ -73,6 +73,25 @@ def write_csv(df: pd.DataFrame, path: str) -> None:
     pacsv.write_csv(table, path)
 
 
+def csv_segments(df: pd.DataFrame):
+    """``csv_bytes`` split into ``(header_line, [row_line, ...])``.
+
+    Every segment keeps its line terminator, so ``header + b"".join(rows)``
+    reconstructs :func:`csv_bytes` exactly — the serving row pool stores the
+    per-row segments and streams arbitrary contiguous slices of them without
+    re-serializing.  Raises :class:`ValueError` when the frame's rows are not
+    line-splittable (a quoted cell containing a newline would make row slices
+    ambiguous); callers fall back to the per-request serialize path.
+    """
+    blob = csv_bytes(df)
+    parts = blob.splitlines(keepends=True)
+    if len(parts) != len(df) + 1:
+        raise ValueError(
+            f"frame is not row-sliceable: {len(df)} rows split into "
+            f"{len(parts) - 1} CSV lines (embedded newline in a cell?)")
+    return parts[0], parts[1:]
+
+
 def csv_bytes(df: pd.DataFrame) -> bytes:
     """``write_csv``'s exact output as bytes (same routing, same writer).
 

@@ -768,6 +768,75 @@ def check_serving_fleet() -> bool:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def check_front_door() -> bool:
+    """The multi-worker front door preserves bytes and fills batches.
+
+    Runs the two load-bearing claims of the N-worker serving pipeline
+    deterministically, in process and off the network: (1) a 4-worker
+    fleet serving a pre-enqueued backlog returns byte-for-byte what a
+    single-worker fleet returns for the same 32 requests — the
+    multi-worker refactor changed scheduling, never content; (2) that
+    same backlog coalesces into full batches, batch_occupancy >= 4
+    (the occupancy-driven-admission criterion; BENCH_r09's starved
+    single-worker coalescer sat at 1.02)."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_doctor_frontdoor_")
+    try:
+        from fed_tgan_tpu.serve.demo import build_demo_artifact
+        from fed_tgan_tpu.serve.fleet import (
+            FleetRegistry,
+            FleetService,
+            ProgramCache,
+            _FleetRequest,
+        )
+
+        root = os.path.join(tmp, "alpha")
+        build_demo_artifact(root, rows=200, epochs=1)
+
+        def run(workers: int):
+            fleet = FleetRegistry(program_cache=ProgramCache(max_entries=16),
+                                  log=lambda *a: None)
+            fleet.load("alpha", root)
+            svc = FleetService(fleet, port=0, max_batch=8, queue_size=64,
+                               max_lanes=4, reload_interval_s=0,
+                               workers=workers, log=lambda *a: None)
+            reqs = [_FleetRequest(tenant="alpha", n=5, seed=2, offset=5 * i,
+                                  condition=None, header=True)
+                    for i in range(32)]
+            for r in reqs:
+                err = svc.submit(fleet.get("alpha"), r)
+                if err is not None:
+                    raise RuntimeError(f"submit shed a request: {err}")
+            svc.start_workers()
+            for r in reqs:
+                if not r.done.wait(timeout=300) or r.status != 200:
+                    raise RuntimeError(
+                        f"request failed: status={r.status} err={r.error}")
+            svc.shutdown(drain=True)
+            return [r.result for r in reqs], svc.metrics.snapshot()
+
+        multi, snap = run(4)
+        single, _ = run(1)
+        if multi != single:
+            return _line(False, "front-door",
+                         "4-worker bytes differ from the single-worker "
+                         "path for the same requests")
+        if snap["batch_occupancy"] < 4.0:
+            return _line(False, "front-door",
+                         "coalescer starved under backlog: occupancy "
+                         f"{snap['batch_occupancy']} < 4")
+        return _line(True, "front-door",
+                     "4-worker bytes == single-worker bytes for 32 "
+                     "requests; batch_occupancy "
+                     f"{snap['batch_occupancy']} >= 4")
+    except Exception as exc:
+        return _line(False, "front-door", f"{exc!r}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def wait_healthy(timeout_min: float = 0.0, quiet_min: float = 45.0,
                  probe_timeout_s: int = 120,
                  _probe=None, _load=None, _sleep=None, _log=print) -> bool:
@@ -1040,7 +1109,7 @@ def check_cost_ledger(timeout: int = 300) -> bool:
         return _line(False, "cost-ledger",
                      f"zero-cost entries: {sorted(hollow)[:3]}")
     checked = []
-    for rec in ("BENCH_r09.json", "BENCH_r10.json"):
+    for rec in ("BENCH_r10.json", "BENCH_r15.json"):
         path = os.path.join(root, rec)
         if not os.path.exists(path):
             continue  # bench records are repo artifacts, not a package part
@@ -1121,6 +1190,7 @@ def main(argv=None) -> int:
         check_cost_ledger(),
         check_serving(),
         check_serving_fleet(),
+        check_front_door(),
     ]
     bad = checks.count(False)
     print(f"{len(checks) - bad}/{len(checks)} checks passed")

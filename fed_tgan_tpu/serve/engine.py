@@ -452,3 +452,29 @@ class SamplingEngine:
             stages["serialize"] = (stages.get("serialize", 0.0)
                                    + time.perf_counter() - t_ser)
         return out
+
+    def sample_csv_segments(self, n: int, seed: int = 0, offset: int = 0,
+                            condition: Optional[int] = None,
+                            snap: Optional[EngineSnapshot] = None,
+                            stages: Optional[dict] = None):
+        """``(header_line, [row_line, ...])`` for rows [offset, offset+n).
+
+        Per-row byte segments of the exact :meth:`sample_csv_bytes` output
+        (same frame, same writer): ``header + b"".join(rows)`` equals the
+        ``header=True`` response and ``b"".join(rows)`` the ``header=False``
+        one.  Row bytes are a pure function of the row's absolute stream
+        position (the determinism contract), so the serving row pool can
+        stitch any contiguous slice of cached segments into a response that
+        is bit-identical to a cold dispatch.  Raises :class:`ValueError`
+        when the frame is not row-sliceable (see ``csvio.csv_segments``)."""
+        from fed_tgan_tpu.data.csvio import csv_segments
+
+        frame = self.sample_frame(n, seed=seed, offset=offset,
+                                  condition=condition, snap=snap,
+                                  stages=stages)
+        t_ser = time.perf_counter()
+        header_line, rows = csv_segments(frame)
+        if stages is not None:
+            stages["serialize"] = (stages.get("serialize", 0.0)
+                                   + time.perf_counter() - t_ser)
+        return header_line, rows

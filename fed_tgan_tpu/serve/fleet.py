@@ -19,17 +19,38 @@ cost one compile, not N.  Different-layout tenants get differently-named
 programs (the ``_L<tag>`` suffix), so the sanitizer compile budget still
 holds per name.
 
-**FleetService** — one bounded queue + one batch worker for the whole
-fleet.  The worker coalesces ACROSS tenants: queued single-chunk requests
-are grouped by bucket key ``(steps, conditional, layout-sig)`` and each
-group rides ONE vmapped device dispatch (per-tenant params/tables stacked
-on a lane axis, output sliced and decoded per tenant on the way out) —
-requests from different tenants with the same encoded layout share a
-device program launch.  Lane programs write into a donated lane-shaped
-scratch exactly like the single-model buckets (``donation_required`` is a
-contract on both).  Multi-chunk requests and singleton groups fall back
-to the tenant engine's path against a per-batch snapshot, so a hot reload
-can never swap a model out from under a batch already formed for it.
+**FleetService** — N batch workers (``workers``) over a sharded bounded
+queue (one shard per worker, round-robin admission, so workers never
+contend on one queue lock).  Each worker coalesces ACROSS tenants:
+queued single-chunk requests are grouped by bucket key ``(steps,
+conditional, layout-sig)`` and each group rides ONE vmapped device
+dispatch (per-tenant params/tables stacked on a lane axis, output sliced
+and decoded per tenant on the way out) — requests from different tenants
+with the same encoded layout share a device program launch.  A bounded
+``coalesce_window_s`` holds a forming batch briefly when more traffic is
+in flight, so lanes actually fill under closed-loop load instead of
+dispatching singletons.  Lane programs write into per-worker donated
+scratch pools (``donation_required`` is a contract on both; per-worker
+pools keep concurrent dispatches from serializing on one scratch lock).
+Multi-chunk requests and singleton groups fall back to the tenant
+engine's path against a per-batch snapshot, so a hot reload can never
+swap a model out from under a batch already formed for it.  The shared
+:class:`ProgramCache` coordinates in-flight builds, so N workers racing
+to the same bucket still compile it exactly once (the sanitizer compile
+budget holds across workers).
+
+An optional :class:`~.pool.RowPool` answers requests whose rows are
+already cached as pre-serialized segments WITHOUT touching the queue —
+the quota token is charged first, so a quota tenant stays pinned even
+when its traffic is all pool hits.
+
+The HTTP layer is selectable: ``http_mode="asyncio"`` (the production
+front door — :mod:`~fed_tgan_tpu.serve.frontdoor`, zero-copy segment
+streaming) or ``"threaded"`` (the legacy stdlib server, kept for
+compatibility; TCP_NODELAY is set either way — stdlib's buffering used
+to interact with Nagle + delayed ACK for a flat ~40 ms per response).
+Both adapt the same :meth:`FleetService.route` table, so routes cannot
+drift between the two.
 
 Admission is per-tenant and two-staged: a token bucket (configured
 requests/second + burst) sheds with **429** ``reason=quota`` BEFORE the
@@ -46,6 +67,7 @@ series), and ``/sample`` as a single-tenant convenience alias.
 
 from __future__ import annotations
 
+import itertools
 import json
 import queue
 import threading
@@ -54,7 +76,7 @@ import urllib.parse
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -66,7 +88,7 @@ from fed_tgan_tpu.serve.engine import (
     SamplingEngine,
     build_bucket_program,
 )
-from fed_tgan_tpu.serve.metrics import FleetMetrics
+from fed_tgan_tpu.serve.metrics import DrainRate, FleetMetrics
 from fed_tgan_tpu.serve.naming import fleet_bucket_name
 from fed_tgan_tpu.serve.registry import ArtifactError, ModelRegistry
 
@@ -129,7 +151,14 @@ class ProgramCache:
     not serialize the request path) and inserts, then evicts from the
     LRU end until both budgets hold.  The just-inserted entry is never
     evicted — a program the caller is about to dispatch must survive its
-    own insertion even when ``est_bytes`` alone exceeds the budget."""
+    own insertion even when ``est_bytes`` alone exceeds the budget.
+
+    In-flight builds are coordinated: the first thread to miss a key
+    registers a build event under the lock and runs ``builder()``; any
+    other thread missing the SAME key waits on that event and then
+    re-reads the cache instead of compiling a duplicate.  That is what
+    keeps the sanitizer compile budget (one compile per program name) an
+    invariant across N concurrent batch workers, not just per worker."""
 
     def __init__(self, max_entries: int = 64,
                  max_bytes: int = 256 * 1024 * 1024):
@@ -137,6 +166,7 @@ class ProgramCache:
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
         self._entries: OrderedDict = OrderedDict()  # key -> (program, bytes)
+        self._building: dict = {}  # key -> threading.Event (build in flight)
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -147,19 +177,31 @@ class ProgramCache:
             return len(self._entries)
 
     def get_or_build(self, key, builder: Callable, est_bytes: int = 0):
+        while True:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return entry[0]
+                in_flight = self._building.get(key)
+                if in_flight is None:
+                    done = threading.Event()
+                    self._building[key] = done
+                    break
+            # another worker is compiling this key right now: wait for it
+            # to land, then re-read (on builder failure the loop retries
+            # the build here instead of propagating a foreign exception)
+            in_flight.wait()
+        try:
+            program = builder()
+        except BaseException:
+            with self._lock:
+                del self._building[key]
+            done.set()
+            raise
         with self._lock:
-            entry = self._entries.get(key)
-            if entry is not None:
-                self._entries.move_to_end(key)
-                self.hits += 1
-                return entry[0]
-        program = builder()
-        with self._lock:
-            racer = self._entries.get(key)
-            if racer is not None:  # another thread built it meanwhile
-                self._entries.move_to_end(key)
-                self.hits += 1
-                return racer[0]
+            del self._building[key]
             self.misses += 1
             self._entries[key] = (program, int(est_bytes))
             self._bytes += int(est_bytes)
@@ -169,7 +211,8 @@ class ProgramCache:
                 _, (_, b) = self._entries.popitem(last=False)
                 self._bytes -= b
                 self.evictions += 1
-            return program
+        done.set()
+        return program
 
     def keys(self) -> list:
         with self._lock:
@@ -300,6 +343,76 @@ class _FleetRequest:
     # the worker at pop time, stage seconds accumulate host-side only
     popped_at: float = 0.0
     stages: dict = field(default_factory=dict)
+    # completion callback (set BEFORE submit, called after done.set()):
+    # the asyncio front door bridges it onto its event loop instead of
+    # parking a thread on the event
+    on_done: Callable | None = None
+
+
+@dataclass
+class Response:
+    """One materialized HTTP response from :meth:`FleetService.route`.
+
+    ``body`` is either ``bytes`` or a list of byte segments — the asyncio
+    front door streams a segment list with ``writelines`` (no join); the
+    stdlib adapter joins (one ``send`` per response is what its
+    unbuffered ``wfile`` wants)."""
+
+    status: int
+    body: Union[bytes, list]
+    ctype: str = "application/json"
+    headers: Optional[dict] = None
+
+    def body_bytes(self) -> bytes:
+        return self.body if isinstance(self.body, bytes) \
+            else b"".join(self.body)
+
+    def content_length(self) -> int:
+        return len(self.body) if isinstance(self.body, bytes) \
+            else sum(len(s) for s in self.body)
+
+
+@dataclass
+class Pending:
+    """A routed request parked on the worker queue: the HTTP layer waits
+    for ``req.done`` (or bridges ``req.on_done``) and then renders
+    :meth:`FleetService.response_for`."""
+
+    req: _FleetRequest
+
+
+def _json_response(status: int, obj: dict,
+                   headers: Optional[dict] = None) -> Response:
+    return Response(status, json.dumps(obj).encode(),
+                    "application/json", headers)
+
+
+class _ScratchPool:
+    """Per-worker donated-scratch rotation (at most 2 dead buffers per
+    shape, same discipline as the engine's pool).  Each batch worker owns
+    one, so concurrent lane dispatches never contend on a shared scratch
+    lock — the lock below is uncontended by construction but still taken
+    (handler threads never touch these; J05 keeps us honest)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bufs: dict = {}
+
+    def take(self, shape: tuple):
+        import jax.numpy as jnp
+
+        with self._lock:
+            bufs = self._bufs.get(shape)
+            if bufs:
+                return bufs.pop()
+        return jnp.zeros(shape, jnp.float32)
+
+    def give(self, buf) -> None:
+        shape = tuple(buf.shape)
+        with self._lock:
+            bufs = self._bufs.setdefault(shape, [])
+            if len(bufs) < 2:
+                bufs.append(buf)
 
 
 @dataclass
@@ -330,13 +443,24 @@ def _stack_pytrees(trees: list):
 
 
 class FleetService:
-    """One bounded queue + one coalescing batch worker over a fleet."""
+    """N coalescing batch workers over a sharded bounded queue.
+
+    ``workers=1`` (the default) preserves the PR 9 single-worker shape
+    exactly; higher counts shard the queue round-robin and run
+    independent batch workers against the shared :class:`ProgramCache`
+    and per-worker scratch pools.  ``coalesce_window_s`` bounds how long
+    a worker holds a forming batch waiting for more traffic;
+    ``row_pool`` (a :class:`~.pool.RowPool`) short-circuits covered
+    requests before the queue; ``http_mode`` picks the front door
+    (``"asyncio"`` or the legacy ``"threaded"``)."""
 
     def __init__(self, fleet: FleetRegistry, host: str = "127.0.0.1",
                  port: int = 0, max_batch: int = 16, queue_size: int = 128,
                  max_lanes: int = 8, queue_share: float = 0.5,
                  request_timeout_s: float = 120.0,
-                 reload_interval_s: float = 5.0, log=print):
+                 reload_interval_s: float = 5.0, workers: int = 1,
+                 coalesce_window_s: float = 0.0, row_pool=None,
+                 http_mode: str = "threaded", log=print):
         self.fleet = fleet
         self.metrics = FleetMetrics()
         self.max_batch = max(1, int(max_batch))
@@ -344,10 +468,25 @@ class FleetService:
         self.queue_share = min(1.0, max(0.0, float(queue_share)))
         self.request_timeout_s = request_timeout_s
         self.reload_interval_s = reload_interval_s
+        self.workers = max(1, int(workers))
+        self.coalesce_window_s = max(0.0, float(coalesce_window_s))
+        self.row_pool = row_pool
+        if http_mode not in ("threaded", "asyncio"):
+            raise ValueError(f"http_mode={http_mode!r}: "
+                             "want 'threaded' or 'asyncio'")
+        self.http_mode = http_mode
         self._log = log
         self._host, self._port = host, port
-        self._queue: queue.Queue = queue.Queue(
-            maxsize=max(1, int(queue_size)))
+        # one queue shard per worker: admission round-robins across
+        # shards, each worker drains only its own — no shared queue lock
+        # on the hot path, aggregate capacity stays `queue_size`
+        total = max(1, int(queue_size))
+        per = -(-total // self.workers)
+        self._queue_size = per * self.workers
+        self._queues: List[queue.Queue] = [
+            queue.Queue(maxsize=per) for _ in range(self.workers)]
+        self._rr = itertools.count()
+        self._drain_rate = DrainRate()
         self._draining = threading.Event()
         self._last_reload_check = time.monotonic()
         # first stage summary goes out with the first batch
@@ -357,31 +496,54 @@ class FleetService:
         self._adm_lock = threading.Lock()
         self._inflight: dict = {}
         self._shed_acc: dict = {}
-        # dead lane-shaped output buffers rotated back in as donated
-        # scratch, same discipline as the engine's per-model pool
-        self._scratch_lock = threading.Lock()
-        self._scratch: dict = {}
+        self._scratch_pools = [_ScratchPool() for _ in range(self.workers)]
         self._httpd: ThreadingHTTPServer | None = None
-        self._worker_thread: threading.Thread | None = None
+        self._frontdoor = None
+        self._worker_threads: List[threading.Thread] = []
         self._serve_thread: threading.Thread | None = None
 
     # ----------------------------------------------------------- lifecycle
 
+    def start_workers(self) -> "FleetService":
+        """Start only the batch workers (no HTTP, no pool filler) — the
+        deterministic seam: tests and the doctor enqueue a backlog first,
+        then start workers and observe the batching that MUST happen."""
+        self._worker_threads = [
+            threading.Thread(target=self._worker, args=(i,),
+                             name=f"fleet-batch-worker-{i}", daemon=True)
+            for i in range(self.workers)
+        ]
+        for t in self._worker_threads:
+            t.start()
+        return self
+
     def start(self) -> "FleetService":
-        handler = _make_fleet_handler(self)
-        self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
-        self._httpd.daemon_threads = True
-        self._worker_thread = threading.Thread(
-            target=self._worker, name="fleet-batch-worker", daemon=True)
-        self._worker_thread.start()
-        self._serve_thread = threading.Thread(
-            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
-            name="fleet-http", daemon=True)
-        self._serve_thread.start()
+        self.start_workers()
+        if self.row_pool is not None:
+            self.row_pool.start()
+        if self.http_mode == "asyncio":
+            from fed_tgan_tpu.serve.frontdoor import AsyncFrontDoor
+
+            self._frontdoor = AsyncFrontDoor(
+                self, host=self._host, port=self._port,
+                request_timeout_s=self.request_timeout_s)
+            self._frontdoor.start()
+        else:
+            handler = _make_fleet_handler(self)
+            self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                              handler)
+            self._httpd.daemon_threads = True
+            self._serve_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                name="fleet-http", daemon=True)
+            self._serve_thread.start()
         return self
 
     @property
     def port(self) -> int:
+        if self._frontdoor is not None:
+            return self._frontdoor.port
         assert self._httpd is not None, "start() first"
         return self._httpd.server_address[1]
 
@@ -391,21 +553,27 @@ class FleetService:
 
     def shutdown(self, drain: bool = True) -> None:
         self._draining.set()
+        if self.row_pool is not None:
+            self.row_pool.stop()
         if not drain:
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if req is not _STOP:
-                    req.error, req.status = "server shutting down", 503
-                    self._finish(req)
-        try:
-            self._queue.put_nowait(_STOP)
-        except queue.Full:
-            pass  # worker is alive and draining; it exits on _draining
-        if self._worker_thread is not None:
-            self._worker_thread.join(timeout=max(self.request_timeout_s, 10))
+            for q in self._queues:
+                while True:
+                    try:
+                        req = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if req is not _STOP:
+                        req.error, req.status = "server shutting down", 503
+                        self._finish(req)
+        for q in self._queues:
+            try:
+                q.put_nowait(_STOP)
+            except queue.Full:
+                pass  # that worker is alive and draining; _draining exits it
+        for t in self._worker_threads:
+            t.join(timeout=max(self.request_timeout_s, 10))
+        if self._frontdoor is not None:
+            self._frontdoor.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -416,8 +584,8 @@ class FleetService:
 
     def tenant_cap(self) -> int:
         """Max in-flight requests one tenant may hold — its fair share of
-        the bounded queue."""
-        return max(1, int(self._queue.maxsize * self.queue_share))
+        the bounded queue (all shards combined)."""
+        return max(1, int(self._queue_size * self.queue_share))
 
     def submit(self, rt: TenantRuntime,
                req: _FleetRequest) -> Optional[str]:
@@ -428,6 +596,13 @@ class FleetService:
         if not rt.bucket.allow():
             self._shed(req.tenant, "quota")
             return "quota"
+        return self.submit_admitted(req)
+
+    def submit_admitted(self, req: _FleetRequest) -> Optional[str]:
+        """Capacity-only admission (the quota token was already spent —
+        the route path charges it before the row-pool lookup)."""
+        if self._draining.is_set():
+            return "capacity"
         cap = self.tenant_cap()
         with self._adm_lock:
             over_cap = self._inflight.get(req.tenant, 0) >= cap
@@ -437,14 +612,28 @@ class FleetService:
         if over_cap:  # shed OUTSIDE _adm_lock: _shed re-acquires it
             self._shed(req.tenant, "capacity")
             return "capacity"
-        try:
-            self._queue.put_nowait(req)
-            return None
-        except queue.Full:
-            with self._adm_lock:
-                self._inflight[req.tenant] -= 1
-            self._shed(req.tenant, "capacity")
-            return "capacity"
+        # round-robin across shards; on a full shard, try the rest before
+        # shedding (a single hot shard must not fake global exhaustion)
+        start = next(self._rr) % self.workers
+        for j in range(self.workers):
+            try:
+                self._queues[(start + j) % self.workers].put_nowait(req)
+                return None
+            except queue.Full:
+                continue
+        with self._adm_lock:
+            self._inflight[req.tenant] -= 1
+        self._shed(req.tenant, "capacity")
+        return "capacity"
+
+    def capacity_retry_after(self) -> float:
+        """503 Retry-After: queued work divided by the fleet's measured
+        aggregate drain rate (scales with the worker count), clamped to
+        a sane band; before any batch has completed, fall back to 1 s."""
+        rate = self._drain_rate.rate()
+        if rate <= 0.0:
+            return 1.0
+        return min(30.0, max(0.05, (self.queue_depth() + 1) / rate))
 
     def _shed(self, tenant: str, reason: str) -> None:
         self.metrics.record_shed(tenant, reason)
@@ -464,7 +653,7 @@ class FleetService:
                     quota=quota, capacity=capacity)
 
     def queue_depth(self) -> int:
-        return self._queue.qsize()
+        return sum(q.qsize() for q in self._queues)
 
     def _finish(self, req: _FleetRequest) -> None:
         with self._adm_lock:
@@ -472,6 +661,9 @@ class FleetService:
             if n > 0:
                 self._inflight[req.tenant] = n - 1
         req.done.set()
+        cb = req.on_done
+        if cb is not None:
+            cb(req)
 
     def _fail(self, req: _FleetRequest, status: int, msg: str) -> None:
         req.error, req.status = msg, status
@@ -480,51 +672,66 @@ class FleetService:
 
     # -------------------------------------------------------------- worker
 
-    def _worker(self) -> None:
+    def _worker(self, wid: int = 0) -> None:
+        q = self._queues[wid]
         while True:
             try:
-                item = self._queue.get(timeout=0.05)
+                item = q.get(timeout=0.05)
             except queue.Empty:
                 if self._draining.is_set():
                     return
-                self._maybe_reload()
+                if wid == 0:  # one reload poller is enough for the fleet
+                    self._maybe_reload()
                 continue
             if item is _STOP:
-                self._process(self._drain_remaining())
+                self._process(self._drain_remaining(q), wid)
                 self._emit_stages(force=True)
                 return
             item.popped_at = time.time()
             batch = [item]
             stop = False
+            # occupancy-driven admission: once a batch is forming, hold it
+            # for at most coalesce_window_s while the queue is quiet —
+            # under closed-loop load the waiting clients land in THIS
+            # batch instead of each riding a singleton dispatch
+            deadline = (time.monotonic() + self.coalesce_window_s
+                        if self.coalesce_window_s > 0 else 0.0)
             while len(batch) < self.max_batch:
                 try:
-                    nxt = self._queue.get_nowait()
+                    nxt = q.get_nowait()
                 except queue.Empty:
-                    break
+                    wait = deadline - time.monotonic()
+                    if wait <= 0 or self._draining.is_set():
+                        break
+                    try:
+                        nxt = q.get(timeout=wait)
+                    except queue.Empty:
+                        break
                 if nxt is _STOP:
                     stop = True
                     break
                 nxt.popped_at = time.time()
                 batch.append(nxt)
-            self._process(batch)
+            self._process(batch, wid)
             if stop:
-                self._process(self._drain_remaining())
+                self._process(self._drain_remaining(q), wid)
                 self._emit_stages(force=True)
                 return
-            self._maybe_reload()
+            if wid == 0:
+                self._maybe_reload()
 
-    def _drain_remaining(self) -> list:
+    def _drain_remaining(self, q: queue.Queue) -> list:
         batch = []
         while True:
             try:
-                req = self._queue.get_nowait()
+                req = q.get_nowait()
             except queue.Empty:
                 return batch
             if req is not _STOP:
                 req.popped_at = time.time()
                 batch.append(req)
 
-    def _process(self, batch: list) -> None:
+    def _process(self, batch: list, wid: int = 0) -> None:
         if not batch:
             return
         self.metrics.record_batch(len(batch))
@@ -560,9 +767,11 @@ class FleetService:
                 continue
             for i in range(0, len(members), self.max_lanes):
                 self._dispatch_lanes(steps, conditional,
-                                     members[i:i + self.max_lanes])
+                                     members[i:i + self.max_lanes],
+                                     self._scratch_pools[wid])
         for member in singles:
             self._run_single(member)
+        self._drain_rate.note(len(batch))
         self.metrics.set_fleet_state(len(self.fleet.names()),
                                      self.fleet.cache.stats())
         self._emit_stages()
@@ -606,22 +815,6 @@ class FleetService:
 
     # --------------------------------------------------------- lane engine
 
-    def _scratch_take(self, shape: tuple):
-        import jax.numpy as jnp
-
-        with self._scratch_lock:
-            bufs = self._scratch.get(shape)
-            if bufs:
-                return bufs.pop()
-        return jnp.zeros(shape, jnp.float32)
-
-    def _scratch_give(self, buf) -> None:
-        shape = tuple(buf.shape)
-        with self._scratch_lock:
-            bufs = self._scratch.setdefault(shape, [])
-            if len(bufs) < 2:
-                bufs.append(buf)
-
     def _lane_program(self, snap: EngineSnapshot, steps: int,
                       conditional: bool, lanes: int):
         key = ("lanes", steps, conditional, lanes, snap.sig)
@@ -651,7 +844,8 @@ class FleetService:
         return self.fleet.cache.get_or_build(key, build, est_bytes=est)
 
     def _dispatch_lanes(self, steps: int, conditional: bool,
-                        members: list) -> None:
+                        members: list,
+                        scratch: Optional[_ScratchPool] = None) -> None:
         """One vmapped device dispatch answering every member: per-tenant
         params/state/cond/tables stacked on a lane axis, lane count padded
         to a power of two (bounded program set) by repeating lane 0, whose
@@ -662,6 +856,8 @@ class FleetService:
         snap0 = members[0].snap
         lanes = min(_pow2(len(members)), self.max_lanes)
         padded = list(members) + [members[0]] * (lanes - len(members))
+        if scratch is None:
+            scratch = self._scratch_pools[0]
         t_start = time.time()
         for m in members:
             self._stamp_wait(m.req, t_start)
@@ -681,14 +877,13 @@ class FleetService:
                 [m.req.condition if m.req.condition is not None else 0
                  for m in padded], np.int32)
             tables = _stack_pytrees([m.snap.tables for m in padded])
-            scratch = self._scratch_take(
-                (lanes, steps * B, len(snap0.layout)))
+            buf = scratch.take((lanes, steps * B, len(snap0.layout)))
             with hot_region(f"serve.fleet[{steps}"
                             f"{'c' if conditional else ''}x{lanes}]"):
                 res = prog(params, state, cond, keys, starts, poss, tables,
-                           scratch)
+                           buf)
             host = np.asarray(res)
-            self._scratch_give(res)
+            scratch.give(res)
         except Exception as exc:  # noqa: BLE001 — fail the whole lane group
             for m in members:
                 self._fail(m.req, 500, repr(exc))
@@ -737,6 +932,10 @@ class FleetService:
             try:
                 if rt.registry.maybe_reload():
                     kept = rt.engine.adopt(rt.registry.get())
+                    if self.row_pool is not None:
+                        # pooled segments belong to the OLD model; a hit
+                        # must never serve rows the new model wouldn't
+                        self.row_pool.invalidate(name)
                     self.metrics.record_reload(name)
                     _emit_event("serve_reload", tenant=name,
                                 model_id=rt.registry.get().model_id,
@@ -772,7 +971,165 @@ class FleetService:
             "cache": self.fleet.cache.stats(),
             "queue_depth": self.queue_depth(),
             "tenant_cap": self.tenant_cap(),
+            "workers": self.workers,
+            "coalesce_window_s": self.coalesce_window_s,
+            "row_pool": (self.row_pool.stats()
+                         if self.row_pool is not None else None),
         }
+
+    # ------------------------------------------------------------- routing
+
+    @staticmethod
+    def _tenant_for(path: str) -> Optional[str]:
+        """``/t/<tenant>/sample`` -> tenant name, else None."""
+        parts = path.split("/")
+        if len(parts) == 4 and parts[1] == "t" and parts[3] == "sample":
+            return urllib.parse.unquote(parts[2])
+        return None
+
+    def route(self, method: str, path: str, params: dict,
+              on_done: Optional[Callable] = None
+              ) -> Union[Response, Pending]:
+        """The single route table both front doors adapt (the stdlib
+        handler and the asyncio server render the SAME responses, so the
+        two HTTP layers cannot drift).  ``params`` is the merged query/
+        JSON-body dict; ``on_done`` is attached to a sampling request
+        BEFORE it is enqueued, so an event-loop waiter never races the
+        worker's completion."""
+        if method == "GET":
+            if path == "/healthz":
+                self.metrics.set_fleet_state(len(self.fleet.names()),
+                                             self.fleet.cache.stats())
+                self.metrics.set_pool_state(
+                    self.row_pool.stats()
+                    if self.row_pool is not None else None)
+                return _json_response(200, {
+                    "status": "draining" if self._draining.is_set()
+                    else "ok",
+                    "tenants": self.fleet.names(),
+                    **self.metrics.snapshot(self.queue_depth()),
+                })
+            if path == "/metrics":
+                self.metrics.set_fleet_state(len(self.fleet.names()),
+                                             self.fleet.cache.stats())
+                self.metrics.set_pool_state(
+                    self.row_pool.stats()
+                    if self.row_pool is not None else None)
+                text = self.metrics.render_prometheus(self.queue_depth())
+                return Response(200, text.encode(),
+                                "text/plain; version=0.0.4")
+            if path == "/fleet":
+                return _json_response(200, self.fleet_status())
+        elif method == "POST" and path == "/fleet":
+            return self._route_admin(params)
+        tenant = self._tenant_for(path)
+        if tenant is None and path == "/sample":
+            rt = self.fleet.sole()
+            if rt is None:
+                return _json_response(400, {
+                    "error": "/sample needs exactly one hot tenant; "
+                             "use /t/<tenant>/sample",
+                    "tenants": self.fleet.names()})
+            tenant = rt.name
+        if tenant is None:
+            return _json_response(404, {"error": f"no route {path}"})
+        return self._route_sample(tenant, params, on_done)
+
+    def _route_admin(self, params: dict) -> Response:
+        action = params.get("action")
+        name = params.get("tenant")
+        if action == "load":
+            if not name or not params.get("root"):
+                return _json_response(400,
+                                      {"error": "load needs {tenant, root}"})
+            try:
+                rt = self.fleet.load(str(name), str(params["root"]))
+            except ArtifactError as exc:
+                return _json_response(400, {"error": str(exc)})
+            return _json_response(200, {
+                "loaded": name, "model_id": rt.registry.get().model_id})
+        if action == "evict":
+            if not name:
+                return _json_response(400, {"error": "evict needs {tenant}"})
+            if self.fleet.evict(str(name)):
+                if self.row_pool is not None:
+                    self.row_pool.invalidate(str(name))
+                return _json_response(200, {"evicted": name})
+            return _json_response(404, {"error": f"no tenant {name!r}",
+                                        "tenants": self.fleet.names()})
+        return _json_response(400, {
+            "error": f"unknown action {action!r} (want load or evict)"})
+
+    def _route_sample(self, tenant: str, params: dict,
+                      on_done: Optional[Callable]
+                      ) -> Union[Response, Pending]:
+        rt = self.fleet.get(tenant)
+        if rt is None:
+            return _json_response(404, {"error": f"no tenant {tenant!r}",
+                                        "tenants": self.fleet.names()})
+        try:
+            n = int(params.get("rows", params.get("n", 0)))
+            seed = int(params.get("seed", 0))
+            offset = int(params.get("offset", 0))
+            header = str(params.get("header", "1")) not in ("0", "false")
+            if n <= 0:
+                raise ValueError(f"rows={n}: need a positive row count")
+            if offset < 0:
+                raise ValueError(f"offset={offset}: must be >= 0")
+        except (TypeError, ValueError) as exc:
+            return _json_response(400, {"error": str(exc)})
+        condition = None
+        column = params.get("column")
+        if column:
+            try:
+                condition = rt.engine.resolve_condition(
+                    column, params.get("value"))
+            except ConditionError as exc:
+                return _json_response(400, {"error": str(exc)})
+        if self._draining.is_set():
+            return _json_response(
+                503, {"error": "draining"},
+                headers={"Retry-After": "1"})
+        # quota FIRST: a pool hit still spends the tenant's token, so a
+        # quota-limited tenant is pinned at its configured rate no matter
+        # how cacheable its traffic is
+        t_admit = time.time()
+        if not rt.bucket.allow():
+            self._shed(tenant, "quota")
+            retry = max(rt.bucket.retry_after_s(), 0.05)
+            return _json_response(
+                429, {"error": f"tenant {tenant!r} over quota"},
+                headers={"Retry-After": f"{retry:.2f}"})
+        if self.row_pool is not None:
+            segments = self.row_pool.get(tenant, seed, offset, n,
+                                         condition, header)
+            if segments is not None:
+                self.metrics.record_pool_hit(
+                    tenant, time.time() - t_admit, n)
+                return Response(200, segments, "text/csv")
+        req = _FleetRequest(tenant=tenant, n=n, seed=seed, offset=offset,
+                            condition=condition, header=header)
+        req.on_done = on_done
+        shed = self.submit_admitted(req)
+        if shed is not None:
+            return _json_response(
+                503,
+                {"error": "draining" if self._draining.is_set()
+                 else "at capacity"},
+                headers={
+                    "Retry-After": f"{self.capacity_retry_after():.2f}"},
+            )
+        return Pending(req)
+
+    @staticmethod
+    def response_for(req: _FleetRequest) -> Response:
+        """Render a finished (or timed-out) sampling request."""
+        if not req.done.is_set():
+            return _json_response(504,
+                                  {"error": "request timed out in queue"})
+        if req.status == 200 and req.result is not None:
+            return Response(200, req.result, "text/csv")
+        return _json_response(req.status, {"error": req.error or "failed"})
 
 
 # ----------------------------------------------------------------- HTTP
@@ -781,184 +1138,52 @@ class FleetService:
 def _make_fleet_handler(service: FleetService):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # stdlib's unbuffered wfile writes headers and body as separate
+        # TCP segments; without NODELAY, Nagle + delayed ACK turns every
+        # response into a flat ~40 ms stall (the whole pre-PR-15 serving
+        # "capacity gap" was this artifact, not compute)
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
-        def _send(self, status: int, body: bytes, ctype: str,
-                  extra: dict | None = None) -> None:
-            self.send_response(status)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            for k, v in (extra or {}).items():
+        def _send_response(self, r: Response) -> None:
+            self.send_response(r.status)
+            self.send_header("Content-Type", r.ctype)
+            self.send_header("Content-Length", str(r.content_length()))
+            for k, v in (r.headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
-            self.wfile.write(body)
+            # one send: the threaded adapter joins segment bodies (each
+            # wfile.write is a raw syscall here; streaming segments is
+            # the asyncio front door's job)
+            self.wfile.write(r.body_bytes())
 
-        def _send_json(self, status: int, obj: dict,
-                       extra: dict | None = None) -> None:
-            self._send(status, json.dumps(obj).encode(), "application/json",
-                       extra)
-
-        def _tenant_for(self, path: str) -> Optional[str]:
-            """``/t/<tenant>/sample`` -> tenant name, else None."""
-            parts = path.split("/")
-            if len(parts) == 4 and parts[1] == "t" and parts[3] == "sample":
-                return urllib.parse.unquote(parts[2])
-            return None
+        def _dispatch(self, method: str, params: dict) -> None:
+            parsed = urllib.parse.urlsplit(self.path)
+            routed = service.route(method, parsed.path, params)
+            if isinstance(routed, Pending):
+                routed.req.done.wait(timeout=service.request_timeout_s)
+                routed = service.response_for(routed.req)
+            self._send_response(routed)
 
         def do_GET(self):
             parsed = urllib.parse.urlsplit(self.path)
-            if parsed.path == "/healthz":
-                service.metrics.set_fleet_state(
-                    len(service.fleet.names()),
-                    service.fleet.cache.stats())
-                self._send_json(200, {
-                    "status": "draining" if service._draining.is_set()
-                    else "ok",
-                    "tenants": service.fleet.names(),
-                    **service.metrics.snapshot(service.queue_depth()),
-                })
-                return
-            if parsed.path == "/metrics":
-                service.metrics.set_fleet_state(
-                    len(service.fleet.names()),
-                    service.fleet.cache.stats())
-                text = service.metrics.render_prometheus(
-                    service.queue_depth())
-                self._send(200, text.encode(), "text/plain; version=0.0.4")
-                return
-            if parsed.path == "/fleet":
-                self._send_json(200, service.fleet_status())
-                return
-            tenant = self._tenant_for(parsed.path)
-            if tenant is None and parsed.path == "/sample":
-                rt = service.fleet.sole()
-                if rt is None:
-                    self._send_json(400, {
-                        "error": "/sample needs exactly one hot tenant; "
-                                 "use /t/<tenant>/sample",
-                        "tenants": service.fleet.names()})
-                    return
-                tenant = rt.name
-            if tenant is None:
-                self._send_json(404, {"error": f"no route {parsed.path}"})
-                return
             params = {k: v[-1] for k, v in
                       urllib.parse.parse_qs(parsed.query).items()}
-            self._handle_sample(tenant, params)
+            self._dispatch("GET", params)
 
         def do_POST(self):
-            parsed = urllib.parse.urlsplit(self.path)
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 params = json.loads(self.rfile.read(length) or b"{}")
                 if not isinstance(params, dict):
                     raise ValueError("body must be a JSON object")
             except (ValueError, json.JSONDecodeError) as exc:
-                self._send_json(400, {"error": f"bad JSON body: {exc}"})
+                self._send_response(_json_response(
+                    400, {"error": f"bad JSON body: {exc}"}))
                 return
-            if parsed.path == "/fleet":
-                self._handle_admin(params)
-                return
-            tenant = self._tenant_for(parsed.path)
-            if tenant is None and parsed.path == "/sample":
-                rt = service.fleet.sole()
-                if rt is None:
-                    self._send_json(400, {
-                        "error": "/sample needs exactly one hot tenant; "
-                                 "use /t/<tenant>/sample",
-                        "tenants": service.fleet.names()})
-                    return
-                tenant = rt.name
-            if tenant is None:
-                self._send_json(404, {"error": f"no route {parsed.path}"})
-                return
-            self._handle_sample(tenant, params)
-
-        def _handle_admin(self, params: dict) -> None:
-            action = params.get("action")
-            name = params.get("tenant")
-            if action == "load":
-                if not name or not params.get("root"):
-                    self._send_json(400, {
-                        "error": "load needs {tenant, root}"})
-                    return
-                try:
-                    rt = service.fleet.load(str(name), str(params["root"]))
-                except ArtifactError as exc:
-                    self._send_json(400, {"error": str(exc)})
-                    return
-                self._send_json(200, {
-                    "loaded": name,
-                    "model_id": rt.registry.get().model_id})
-            elif action == "evict":
-                if not name:
-                    self._send_json(400, {"error": "evict needs {tenant}"})
-                    return
-                if service.fleet.evict(str(name)):
-                    self._send_json(200, {"evicted": name})
-                else:
-                    self._send_json(404, {
-                        "error": f"no tenant {name!r}",
-                        "tenants": service.fleet.names()})
-            else:
-                self._send_json(400, {
-                    "error": f"unknown action {action!r} "
-                             "(want load or evict)"})
-
-        def _handle_sample(self, tenant: str, params: dict) -> None:
-            rt = service.fleet.get(tenant)
-            if rt is None:
-                self._send_json(404, {
-                    "error": f"no tenant {tenant!r}",
-                    "tenants": service.fleet.names()})
-                return
-            try:
-                n = int(params.get("rows", params.get("n", 0)))
-                seed = int(params.get("seed", 0))
-                offset = int(params.get("offset", 0))
-                header = str(params.get("header", "1")) not in ("0", "false")
-                if n <= 0:
-                    raise ValueError(f"rows={n}: need a positive row count")
-                if offset < 0:
-                    raise ValueError(f"offset={offset}: must be >= 0")
-            except (TypeError, ValueError) as exc:
-                self._send_json(400, {"error": str(exc)})
-                return
-            condition = None
-            column = params.get("column")
-            if column:
-                try:
-                    condition = rt.engine.resolve_condition(
-                        column, params.get("value"))
-                except ConditionError as exc:
-                    self._send_json(400, {"error": str(exc)})
-                    return
-            req = _FleetRequest(tenant=tenant, n=n, seed=seed, offset=offset,
-                                condition=condition, header=header)
-            shed = service.submit(rt, req)
-            if shed == "quota":
-                retry = max(rt.bucket.retry_after_s(), 0.05)
-                self._send_json(
-                    429, {"error": f"tenant {tenant!r} over quota"},
-                    extra={"Retry-After": f"{retry:.2f}"})
-                return
-            if shed is not None:
-                self._send_json(
-                    503,
-                    {"error": "draining" if service._draining.is_set()
-                     else "at capacity"},
-                    extra={"Retry-After": "1"},
-                )
-                return
-            if not req.done.wait(timeout=service.request_timeout_s):
-                self._send_json(504, {"error": "request timed out in queue"})
-                return
-            if req.status == 200 and req.result is not None:
-                self._send(200, req.result, "text/csv")
-            else:
-                self._send_json(req.status, {"error": req.error or "failed"})
+            self._dispatch("POST", params)
 
     return Handler
 
@@ -1000,6 +1225,25 @@ def fleet_main(argv=None) -> int:
                     help="compiled-program LRU entry budget")
     ap.add_argument("--cache-mb", type=float, default=256.0,
                     help="compiled-program LRU byte budget (estimated)")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="batch workers draining a sharded queue (the "
+                         "shared program cache still compiles each bucket "
+                         "once across all of them)")
+    ap.add_argument("--coalesce-window", type=float, default=0.0,
+                    help="seconds a worker holds a forming batch for more "
+                         "traffic (occupancy-driven admission; 0 = "
+                         "dispatch immediately)")
+    ap.add_argument("--http", choices=("asyncio", "threaded"),
+                    default="asyncio",
+                    help="front door: asyncio event loop with zero-copy "
+                         "segment streaming, or the legacy threaded "
+                         "stdlib server")
+    ap.add_argument("--row-pool-chunks", type=int, default=8,
+                    help="pre-generated row-pool chunks kept per hot "
+                         "(tenant, seed, condition) stream "
+                         "(0 disables the pool)")
+    ap.add_argument("--row-pool-chunk-rows", type=int, default=2048,
+                    help="rows per pre-generated pool chunk")
     ap.add_argument("--request-timeout", type=float, default=120.0,
                     help="seconds a request may wait before 504")
     ap.add_argument("--reload-interval", type=float, default=5.0,
@@ -1043,13 +1287,22 @@ def fleet_main(argv=None) -> int:
         except ArtifactError as exc:
             print(f"fleet: tenant {name!r}: {exc}")
             return 2
+    row_pool = None
     service = FleetService(
         fleet, host=args.host, port=args.port, max_batch=args.max_batch,
         queue_size=args.queue_size, max_lanes=args.max_lanes,
         queue_share=args.queue_share,
         request_timeout_s=args.request_timeout,
-        reload_interval_s=args.reload_interval, log=log,
+        reload_interval_s=args.reload_interval, workers=args.workers,
+        coalesce_window_s=args.coalesce_window, http_mode=args.http,
+        log=log,
     )
+    if args.row_pool_chunks > 0:
+        from fed_tgan_tpu.serve.pool import RowPool
+
+        row_pool = RowPool(fleet, chunk_rows=args.row_pool_chunk_rows,
+                           max_chunks_per_key=args.row_pool_chunks)
+        service.row_pool = row_pool
     service.start()
     print(f"serving {len(pairs)} tenant(s) on {service.url}  "
           f"(endpoints: /t/<tenant>/sample /fleet /healthz /metrics; "

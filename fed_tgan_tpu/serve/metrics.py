@@ -31,6 +31,34 @@ from fed_tgan_tpu.obs.registry import MetricsRegistry
 STAGES = ("queue_wait", "batch_form", "dispatch", "decode", "serialize")
 
 
+class DrainRate:
+    """Aggregate worker drain rate (requests/second), EWMA-smoothed.
+
+    Every batch worker notes each batch it completes; the sample interval
+    is measured between consecutive notes from ANY worker, so the
+    estimate reflects the service's combined drain rate and scales with
+    the worker count — the 503 Retry-After hint divides queue depth by
+    this instead of assuming one worker's throughput."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rate = 0.0
+        self._t = time.monotonic()
+
+    def note(self, n_requests: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            dt = max(now - self._t, 1e-6)
+            self._t = now
+            sample = n_requests / dt
+            self._rate = sample if self._rate <= 0.0 \
+                else 0.2 * sample + 0.8 * self._rate
+
+    def rate(self) -> float:
+        with self._lock:
+            return self._rate
+
+
 def _quantile(sorted_vals: list, q: float) -> float:
     """Nearest-rank quantile on an already-sorted list."""
     if not sorted_vals:
@@ -246,6 +274,14 @@ class FleetMetrics:
         self._lanes_occupied = self.registry.gauge(
             "lanes_occupied",
             "lanes filled by the most recent coalesced dispatch")
+        # row-pool gauges (all zero when no pool is configured): pushed
+        # at scrape time from RowPool.stats(), same pattern as the LRU
+        self._pool_gauges = {
+            key: self.registry.gauge(
+                f"row_pool_{key}", f"row pool {key.replace('_', ' ')}")
+            for key in ("keys", "chunks", "rows", "hits", "misses",
+                        "fills", "evictions")
+        }
 
     def _bundle(self, tenant: str) -> dict:
         with self._tlock:
@@ -263,6 +299,9 @@ class FleetMetrics:
                         "errors_total", "requests failed", labels=lab),
                     "reloads": reg.counter(
                         "reloads_total", "model hot reloads", labels=lab),
+                    "pool_hits": reg.counter(
+                        "pool_hits_total",
+                        "requests answered from the row pool", labels=lab),
                     "shed_quota": reg.counter(
                         "shed_total", "requests shed at admission",
                         labels={"tenant": tenant, "reason": "quota"}),
@@ -301,6 +340,17 @@ class FleetMetrics:
         b["rows"].inc(rows)
         b["latency"].observe(latency_s)
 
+    def record_pool_hit(self, tenant: str, latency_s: float,
+                        rows: int) -> None:
+        """A request answered from the row pool — it still counts as a
+        served request (the bench's headline and the quota math see it),
+        but it never reaches a worker batch, so occupancy excludes it."""
+        b = self._bundle(tenant)
+        b["requests"].inc()
+        b["rows"].inc(rows)
+        b["pool_hits"].inc()
+        b["latency"].observe(latency_s)
+
     def record_shed(self, tenant: str, reason: str) -> None:
         b = self._bundle(tenant)
         b["shed_quota" if reason == "quota" else "shed_capacity"].inc()
@@ -333,6 +383,10 @@ class FleetMetrics:
         self._cache_misses.set(cache_stats.get("misses", 0))
         self._cache_evictions.set(cache_stats.get("evictions", 0))
 
+    def set_pool_state(self, pool_stats: Optional[dict]) -> None:
+        for key, gauge in self._pool_gauges.items():
+            gauge.set(int((pool_stats or {}).get(key, 0)))
+
     # --------------------------------------------------------- export
 
     def stage_snapshots(self) -> dict:
@@ -357,6 +411,7 @@ class FleetMetrics:
             **extra,
             "requests_total": int(b["requests"].value),
             "rows_total": int(b["rows"].value),
+            "pool_hits_total": int(b["pool_hits"].value),
             "errors_total": int(b["errors"].value),
             "reloads_total": int(b["reloads"].value),
             "shed_quota_total": int(b["shed_quota"].value),
@@ -372,17 +427,23 @@ class FleetMetrics:
         uptime = max(time.time() - self.started_at, 1e-9)
         requests = sum(t["requests_total"] for t in per_tenant.values())
         rows = sum(t["rows_total"] for t in per_tenant.values())
+        pool_hits = sum(t["pool_hits_total"] for t in per_tenant.values())
         batches = int(self._batches.value)
+        # occupancy is a property of the DISPATCHED path: pool hits never
+        # form a batch, so they are excluded from the numerator — a high
+        # hit rate cannot mask a starved coalescer
+        dispatched = requests - pool_hits
         return {
             "uptime_s": round(uptime, 3),
             "requests_total": requests,
             "rows_total": rows,
+            "pool_hits_total": pool_hits,
             "batches_total": batches,
             "lane_dispatches_total": int(self._lane_dispatches.value),
             "lane_requests_total": int(self._lane_requests.value),
             "queue_depth": queue_depth,
             "lanes_occupied": int(self._lanes_occupied.value),
-            "batch_occupancy": round(requests / batches, 3)
+            "batch_occupancy": round(dispatched / batches, 3)
             if batches else 0.0,
             "rows_per_sec": round(rows / uptime, 1),
             "tenants": per_tenant,

@@ -1,14 +1,18 @@
 """Long-lived stdlib-only sampling server + the paired CLI client.
 
-Request path: HTTP handler threads validate and enqueue; one batch worker
-drains up to ``max_batch`` queued requests per cycle (micro-batch
+Request path: HTTP handler threads validate and enqueue; N batch workers
+(``workers``, default 1) each drain their own shard of a bounded queue,
+coalescing up to ``max_batch`` queued requests per cycle (micro-batch
 coalescing — under concurrent clients the queue builds while a batch
 computes, so the next cycle serves several requests back-to-back without
-re-entering the Python dispatch overhead per request), runs them through
-the compiled engine, and flips each request's event.  The queue is
-bounded: a full queue sheds load with 503 + Retry-After instead of
+re-entering the Python dispatch overhead per request), run them through
+the compiled engine, and flip each request's event.  A bounded
+``coalesce_window_s`` optionally holds a forming batch for more traffic
+so lanes actually fill under closed-loop load.  The queue is bounded: a
+full queue sheds load with 503 + a Retry-After computed from the
+fleet-wide measured drain rate (scales with the worker count) instead of
 building an unbounded latency tail.  Shutdown drains: new requests are
-rejected, everything already queued is answered, then the worker exits.
+rejected, everything already queued is answered, then the workers exit.
 
 Endpoints:
 
@@ -24,6 +28,7 @@ inside the engine the worker calls.
 
 from __future__ import annotations
 
+import itertools
 import json
 import queue
 import threading
@@ -33,10 +38,11 @@ import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List
 
 from fed_tgan_tpu.obs.journal import emit as _emit_event
 from fed_tgan_tpu.serve.engine import ConditionError, SamplingEngine
-from fed_tgan_tpu.serve.metrics import ServiceMetrics
+from fed_tgan_tpu.serve.metrics import DrainRate, ServiceMetrics
 from fed_tgan_tpu.serve.registry import ModelRegistry
 
 _STOP = object()
@@ -68,22 +74,33 @@ class SamplingService:
     def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
                  port: int = 0, max_batch: int = 8, queue_size: int = 64,
                  request_timeout_s: float = 120.0,
-                 reload_interval_s: float = 5.0, log=print):
+                 reload_interval_s: float = 5.0, workers: int = 1,
+                 coalesce_window_s: float = 0.0, log=print):
         self.registry = registry
         self.engine = SamplingEngine(registry.get())
         self.metrics = ServiceMetrics()
         self.max_batch = max(1, int(max_batch))
         self.request_timeout_s = request_timeout_s
         self.reload_interval_s = reload_interval_s
+        self.workers = max(1, int(workers))
+        self.coalesce_window_s = max(0.0, float(coalesce_window_s))
         self._log = log
         self._host, self._port = host, port
-        self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(queue_size)))
+        # one queue shard per worker (round-robin admission, each worker
+        # drains only its own) — same sharding as the fleet service
+        total = max(1, int(queue_size))
+        per = -(-total // self.workers)
+        self._queue_size = per * self.workers
+        self._queues: List[queue.Queue] = [
+            queue.Queue(maxsize=per) for _ in range(self.workers)]
+        self._rr = itertools.count()
+        self._drain_rate = DrainRate()
         self._draining = threading.Event()
         self._last_reload_check = time.monotonic()
         # first stage summary goes out with the first batch
         self._last_stage_emit = float("-inf")
         self._httpd: ThreadingHTTPServer | None = None
-        self._worker_thread: threading.Thread | None = None
+        self._worker_threads: List[threading.Thread] = []
         self._serve_thread: threading.Thread | None = None
 
     # ----------------------------------------------------------- lifecycle
@@ -92,9 +109,13 @@ class SamplingService:
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
         self._httpd.daemon_threads = True
-        self._worker_thread = threading.Thread(
-            target=self._worker, name="serve-batch-worker", daemon=True)
-        self._worker_thread.start()
+        self._worker_threads = [
+            threading.Thread(target=self._worker, args=(i,),
+                             name=f"serve-batch-worker-{i}", daemon=True)
+            for i in range(self.workers)
+        ]
+        for t in self._worker_threads:
+            t.start()
         self._serve_thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
             name="serve-http", daemon=True)
@@ -115,20 +136,22 @@ class SamplingService:
         self._draining.set()
         if not drain:
             # fail queued requests instead of computing them
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if req is not _STOP:
-                    req.error, req.status = "server shutting down", 503
-                    req.done.set()
-        try:
-            self._queue.put_nowait(_STOP)
-        except queue.Full:
-            pass  # worker is alive and draining; it exits on _draining
-        if self._worker_thread is not None:
-            self._worker_thread.join(timeout=max(self.request_timeout_s, 10))
+            for q in self._queues:
+                while True:
+                    try:
+                        req = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if req is not _STOP:
+                        req.error, req.status = "server shutting down", 503
+                        req.done.set()
+        for q in self._queues:
+            try:
+                q.put_nowait(_STOP)
+            except queue.Full:
+                pass  # that worker is alive and draining; _draining exits it
+        for t in self._worker_threads:
+            t.join(timeout=max(self.request_timeout_s, 10))
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -138,42 +161,68 @@ class SamplingService:
     # -------------------------------------------------------- request path
 
     def submit(self, req: _Request) -> bool:
-        """Enqueue; False = shed (queue full or draining)."""
+        """Enqueue; False = shed (queue full or draining).  Round-robin
+        across shards; a full shard tries the rest before shedding."""
         if self._draining.is_set():
             return False
-        try:
-            self._queue.put_nowait(req)
-            return True
-        except queue.Full:
-            self.metrics.record_shed()
-            return False
+        start = next(self._rr) % self.workers
+        for j in range(self.workers):
+            try:
+                self._queues[(start + j) % self.workers].put_nowait(req)
+                return True
+            except queue.Full:
+                continue
+        self.metrics.record_shed()
+        return False
 
     def queue_depth(self) -> int:
-        return self._queue.qsize()
+        return sum(q.qsize() for q in self._queues)
+
+    def capacity_retry_after(self) -> float:
+        """503 Retry-After: queued work over the measured aggregate drain
+        rate (scales with the worker count), clamped to a sane band;
+        before any batch has completed, fall back to 1 s."""
+        rate = self._drain_rate.rate()
+        if rate <= 0.0:
+            return 1.0
+        return min(30.0, max(0.05, (self.queue_depth() + 1) / rate))
 
     # ------------------------------------------------------------- worker
 
-    def _worker(self) -> None:
+    def _worker(self, wid: int = 0) -> None:
+        q = self._queues[wid]
         while True:
             try:
-                item = self._queue.get(timeout=0.05)
+                item = q.get(timeout=0.05)
             except queue.Empty:
                 if self._draining.is_set():
                     return
-                self._maybe_reload()
+                if wid == 0:  # one reload poller is enough
+                    self._maybe_reload()
                 continue
             if item is _STOP:
-                self._process(self._drain_remaining())
+                self._process(self._drain_remaining(q))
                 self._emit_stages(force=True)
                 return
             item.popped_at = time.time()
             batch = [item]
             stop = False
+            # occupancy-driven admission: hold the forming batch for at
+            # most coalesce_window_s while the shard is quiet, so closed-
+            # loop clients land in THIS batch instead of singletons
+            deadline = (time.monotonic() + self.coalesce_window_s
+                        if self.coalesce_window_s > 0 else 0.0)
             while len(batch) < self.max_batch:
                 try:
-                    nxt = self._queue.get_nowait()
+                    nxt = q.get_nowait()
                 except queue.Empty:
-                    break
+                    wait = deadline - time.monotonic()
+                    if wait <= 0 or self._draining.is_set():
+                        break
+                    try:
+                        nxt = q.get(timeout=wait)
+                    except queue.Empty:
+                        break
                 if nxt is _STOP:
                     stop = True
                     break
@@ -181,16 +230,17 @@ class SamplingService:
                 batch.append(nxt)
             self._process(batch)
             if stop:
-                self._process(self._drain_remaining())
+                self._process(self._drain_remaining(q))
                 self._emit_stages(force=True)
                 return
-            self._maybe_reload()
+            if wid == 0:
+                self._maybe_reload()
 
-    def _drain_remaining(self) -> list:
+    def _drain_remaining(self, q: queue.Queue) -> list:
         batch = []
         while True:
             try:
-                req = self._queue.get_nowait()
+                req = q.get_nowait()
             except queue.Empty:
                 return batch
             if req is not _STOP:
@@ -232,6 +282,7 @@ class SamplingService:
                 self.metrics.record_error()
             finally:
                 req.done.set()
+        self._drain_rate.note(len(batch))
         self._emit_stages()
 
     def _emit_stages(self, force: bool = False) -> None:
@@ -270,6 +321,10 @@ class SamplingService:
 def _make_handler(service: SamplingService):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # stdlib's unbuffered wfile writes headers and body as separate
+        # TCP segments; without NODELAY, Nagle + delayed ACK stalls every
+        # small response ~40 ms on loopback
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # quiet by default
             pass
@@ -357,7 +412,8 @@ def _make_handler(service: SamplingService):
                     503,
                     {"error": "draining" if service._draining.is_set()
                      else "queue full"},
-                    extra={"Retry-After": "1"},
+                    extra={"Retry-After":
+                           f"{service.capacity_retry_after():.2f}"},
                 )
                 return
             if not req.done.wait(timeout=service.request_timeout_s):
@@ -391,6 +447,11 @@ def serve_main(argv=None) -> int:
                     help="max requests coalesced per worker cycle")
     ap.add_argument("--queue-size", type=int, default=64,
                     help="bounded request queue; full = shed with 503")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="batch workers draining a sharded queue")
+    ap.add_argument("--coalesce-window", type=float, default=0.0,
+                    help="seconds a worker holds a forming batch for more "
+                         "traffic (0 = dispatch immediately)")
     ap.add_argument("--request-timeout", type=float, default=120.0,
                     help="seconds a request may wait before 504")
     ap.add_argument("--reload-interval", type=float, default=5.0,
@@ -423,7 +484,8 @@ def serve_main(argv=None) -> int:
             registry, host=args.host, port=args.port,
             max_batch=args.max_batch, queue_size=args.queue_size,
             request_timeout_s=args.request_timeout,
-            reload_interval_s=args.reload_interval, log=log,
+            reload_interval_s=args.reload_interval, workers=args.workers,
+            coalesce_window_s=args.coalesce_window, log=log,
         )
     except ArtifactError as exc:
         print(f"serve: {exc}")

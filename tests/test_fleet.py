@@ -289,8 +289,9 @@ def test_quota_shed_is_per_tenant_and_fair(fleet):
         assert snap["tenants"]["beta"]["shed_capacity_total"] == 1
     finally:
         capped.bucket = TokenBucket(0.0)
-        while svc.queue_depth():  # drop the never-drained requests
-            svc._queue.get_nowait()
+        for q in svc._queues:  # drop the never-drained requests
+            while not q.empty():
+                q.get_nowait()
 
 
 def test_submit_sheds_capacity_when_draining(fleet):

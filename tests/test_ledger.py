@@ -128,9 +128,13 @@ def test_slo_journal_figures_fold_and_gate(tmp_path):
 
 def test_slo_accepts_checked_in_bench_records():
     """The packaged budgets must describe the repo's own artifacts --
-    zero regressions AND zero stale warnings on the seeded records."""
+    zero regressions AND zero stale warnings on the seeded records.
+
+    BENCH_r09 stays on disk as a historical record of the single-worker
+    front door, but the fleet budgets were re-seeded to the multi-worker
+    BENCH_r15 regime, so that is the record they gate."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for rec in ("BENCH_r09.json", "BENCH_r10.json"):
+    for rec in ("BENCH_r10.json", "BENCH_r15.json"):
         path = os.path.join(root, rec)
         if not os.path.exists(path):
             pytest.skip(f"{rec} not on disk")
