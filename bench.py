@@ -1642,6 +1642,104 @@ def bench_serving(duration_s: float = 15.0, clients: int = 4,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_canary(shadow_rows: int = 256, score_reps: int = 5,
+                 seed: int = 0) -> dict:
+    """Canary quality gate: shadow-scoring latency + decision timeline.
+
+    Two claims the quality control plane rests on: (1) scoring a
+    candidate's shadow rows against the tenant's reference statistics is
+    cheap enough to sit on the reload poll path (``score_seconds_p50``,
+    measured over ``score_reps`` warm passes — warm-up rep compiles the
+    sampling bucket off the clock); (2) the gate's decisions are correct —
+    a clean republished generation PROMOTES and a ``degrade_snapshot``
+    (x100)-damaged checkpoint REJECTS while the incumbent keeps serving
+    (``decisions_correct_frac``, pinned to 1.0 by the ``canary-decisions``
+    budget)."""
+    import shutil
+    import tempfile
+
+    from fed_tgan_tpu.serve.canary import (CanaryConfig, CanaryGate,
+                                           load_reference_stats,
+                                           reference_stats_path,
+                                           score_frame)
+    from fed_tgan_tpu.serve.demo import (build_demo_artifact,
+                                         republish_demo_candidate)
+    from fed_tgan_tpu.serve.engine import SamplingEngine
+    from fed_tgan_tpu.serve.registry import ModelRegistry
+    from fed_tgan_tpu.testing.faults import degrade_checkpoint
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_bench_canary_")
+    try:
+        build_demo_artifact(tmp, rows=400, epochs=1, seed=seed)
+        registry = ModelRegistry(tmp, log=lambda *a: None)
+        engine = SamplingEngine(registry.get())
+        gate = CanaryGate(registry, engine,
+                          config=CanaryConfig(shadow_rows=shadow_rows),
+                          log=lambda *a: None)
+        art = registry.get().artifact
+        stats = load_reference_stats(
+            reference_stats_path(art.models_dir, art.name))
+
+        engine.sample_frame(shadow_rows, seed=seed)  # warm-up off the clock
+        score_s = []
+        for rep in range(score_reps):
+            t0 = time.time()
+            frame = engine.sample_frame(shadow_rows, seed=seed + 1 + rep)
+            score_frame(stats, frame)
+            score_s.append(time.time() - t0)
+        score_s.sort()
+        p50 = score_s[len(score_s) // 2]
+
+        # decision timeline: clean generation must promote, damaged
+        # generation must reject — both through the same consider() path
+        # the serving reload loop calls
+        first_id = registry.get().model_id
+        decisions = []
+
+        republish_demo_candidate(tmp)
+        t0 = time.time()
+        clean = gate.consider()
+        promoted = bool(clean and clean["promoted"]
+                        and registry.get().model_id != first_id)
+        decisions.append({"step": "clean_republish", "expected": "promote",
+                          "promoted": bool(clean and clean["promoted"]),
+                          "correct": promoted,
+                          "seconds": round(time.time() - t0, 3)})
+        if promoted:
+            engine.adopt(registry.get())  # mirror the service reload path
+        promoted_id = registry.get().model_id
+
+        degrade_checkpoint(os.path.join(tmp, "models", "synthesizer"),
+                           100.0)
+        t0 = time.time()
+        bad = gate.consider()
+        rejected = bool(bad and not bad["promoted"]
+                        and registry.get().model_id == promoted_id)
+        decisions.append({"step": "degrade_snapshot_x100",
+                          "expected": "reject",
+                          "promoted": bool(bad and bad["promoted"]),
+                          "correct": rejected,
+                          "tripped": list(bad["tripped"]) if bad else [],
+                          "seconds": round(time.time() - t0, 3)})
+
+        correct = sum(1 for d in decisions if d["correct"])
+        return {
+            "metric": "bench_canary(demo)",
+            "value": round(p50, 3),
+            "unit": f"s shadow-score p50 ({shadow_rows} shadow rows)",
+            "vs_baseline": 0,
+            "score_seconds_p50": round(p50, 3),
+            "score_seconds": [round(s, 3) for s in score_s],
+            "shadow_rows": shadow_rows,
+            "promotions": gate.promotions,
+            "rejections": gate.rejections,
+            "decisions_correct_frac": correct / len(decisions),
+            "decisions": decisions,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_serving_fleet(tenants: int = 4, clients_per_tenant: int = 8,
                         rows_per_request: int = 50,
                         target_requests: int = 100_000,
@@ -2008,7 +2106,7 @@ def main() -> int:
     ap.add_argument("--workload",
                     choices=["round", "full500", "utility", "multihost",
                              "scale", "adult", "serving", "serving-fleet",
-                             "onboard"],
+                             "onboard", "canary"],
                     default="round")
     ap.add_argument("--rows", type=int, default=None,
                     help="scale/adult workloads: synthetic table row count "
@@ -2153,7 +2251,7 @@ def main() -> int:
     # trains its own demo artifact — neither reads the Intrusion CSV, so
     # don't require it there
     if args.workload not in ("scale", "adult", "serving",
-                             "serving-fleet", "onboard") \
+                             "serving-fleet", "onboard", "canary") \
             and not os.path.exists(CSV_PATH):
         ap.error(f"Intrusion CSV not found at {CSV_PATH}; point --csv or "
                  "FED_TGAN_BENCH_CSV at a copy")
@@ -2347,6 +2445,8 @@ def _is_backend_unavailable(exc: BaseException) -> bool:
 def _dispatch_workload(args, bgm, clients, epochs, rows, shard_strategy):
     if args.workload == "serving":
         return bench_serving(clients=clients, precision=args.precision)
+    if args.workload == "canary":
+        return bench_canary()
     if args.workload == "serving-fleet":
         # `clients` is the TENANT count here (default 4, ISSUE floor);
         # each tenant gets 8 closed-loop raw-socket client connections

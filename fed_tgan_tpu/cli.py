@@ -991,6 +991,16 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
                 encoder_artifact(table_meta.categorical_columns, encoders), f
             )
         save_synthesizer(synth, os.path.join(models_dir, "synthesizer"))
+        # reference statistics for the canary promotion gate (--promote
+        # canary scores future checkpoint generations against these)
+        from fed_tgan_tpu.serve.canary import (compute_reference_stats,
+                                               reference_stats_path,
+                                               write_reference_stats)
+
+        stats = compute_reference_stats(
+            df, table_meta.categorical_columns, name=name,
+            probe_rows=min(64, len(df)))
+        write_reference_stats(stats, reference_stats_path(models_dir, name))
 
     if args.eval:
         from fed_tgan_tpu.eval.similarity import statistical_similarity
@@ -1196,6 +1206,24 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
         from fed_tgan_tpu.runtime.checkpoint import save_synthesizer
 
         save_synthesizer(trainer, os.path.join(models_dir, "synthesizer"))
+        if frames is not None:
+            # reference statistics for the canary promotion gate; the
+            # remote path (frames is None) derives them on demand from
+            # the incumbent model instead
+            from fed_tgan_tpu.serve.canary import (compute_reference_stats,
+                                                   reference_stats_path,
+                                                   write_reference_stats)
+
+            real = pd.concat(frames) if len(frames) > 1 else frames[0]
+            # score only the synthesized schema, not every CSV column
+            cols = [c for c in init.global_meta.column_names
+                    if c in real.columns]
+            real = real[cols]
+            stats = compute_reference_stats(
+                real, init.global_meta.categorical_columns, name=name,
+                probe_rows=min(64, len(real)))
+            write_reference_stats(stats,
+                                  reference_stats_path(models_dir, name))
 
     if hasattr(trainer, "write_timing"):
         trainer.write_timing(args.out_dir)

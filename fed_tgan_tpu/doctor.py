@@ -837,6 +837,73 @@ def check_front_door() -> bool:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def check_quality_canary() -> bool:
+    """The canary promotion gate promotes clean bytes and rejects damage.
+
+    Builds the demo artifact, republishes a clean generation and verifies
+    the gate promotes it; then degrades the published checkpoint in place
+    (structurally valid, quality-destroyed — exactly the failure the
+    immediate reload path waves through) and verifies the gate rejects it
+    with per-column forensics while the promoted model keeps serving.
+    Clean-first ordering matters: ``republish_demo_candidate`` derives
+    its generation from the published bytes, so degrading first would
+    poison the "clean" republish too."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_doctor_canary_")
+    try:
+        from fed_tgan_tpu.serve.canary import CanaryConfig, CanaryGate
+        from fed_tgan_tpu.serve.demo import (
+            build_demo_artifact,
+            republish_demo_candidate,
+        )
+        from fed_tgan_tpu.serve.engine import SamplingEngine
+        from fed_tgan_tpu.serve.registry import ModelRegistry
+        from fed_tgan_tpu.testing.faults import degrade_checkpoint
+
+        build_demo_artifact(tmp, rows=200, epochs=1)
+        registry = ModelRegistry(tmp, log=lambda *a: None)
+        engine = SamplingEngine(registry.get())
+        gate = CanaryGate(registry, engine,
+                          config=CanaryConfig(shadow_rows=128),
+                          log=lambda *a: None)
+        first_id = registry.get().model_id
+
+        republish_demo_candidate(tmp)
+        clean = gate.consider()
+        if clean is None or not clean["promoted"]:
+            return _line(False, "quality-canary",
+                         f"clean republish not promoted ({clean})")
+        if registry.get().model_id == first_id:
+            return _line(False, "quality-canary",
+                         "promotion did not install the new generation")
+        engine.adopt(registry.get())
+        promoted_id = registry.get().model_id
+
+        degrade_checkpoint(os.path.join(tmp, "models", "synthesizer"),
+                           100.0)
+        decision = gate.consider()
+        if decision is None or decision["promoted"]:
+            return _line(False, "quality-canary",
+                         f"degraded checkpoint not rejected ({decision})")
+        if registry.get().model_id != promoted_id:
+            return _line(False, "quality-canary",
+                         "rejected candidate replaced the serving model")
+        if not decision["tripped"] or not decision["per_column"]:
+            return _line(False, "quality-canary",
+                         "rejection carried no forensics "
+                         f"({decision['tripped']})")
+        return _line(True, "quality-canary",
+                     f"clean generation promoted to {promoted_id}; "
+                     f"degraded generation rejected (tripped "
+                     f"{decision['tripped']}, {promoted_id} kept serving)")
+    except Exception as exc:
+        return _line(False, "quality-canary", f"{exc!r}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def wait_healthy(timeout_min: float = 0.0, quiet_min: float = 45.0,
                  probe_timeout_s: int = 120,
                  _probe=None, _load=None, _sleep=None, _log=print) -> bool:
@@ -1191,6 +1258,7 @@ def main(argv=None) -> int:
         check_serving(),
         check_serving_fleet(),
         check_front_door(),
+        check_quality_canary(),
     ]
     bad = checks.count(False)
     print(f"{len(checks) - bad}/{len(checks)} checks passed")

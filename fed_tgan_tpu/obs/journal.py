@@ -34,6 +34,14 @@ compile                   XLA compile event (from the sanitizer counter)
 backend_probe             subprocess backend-responsiveness probe outcome
 device_trace              runtime/profiling device trace start/stop/failure
 serve_reload              serving hot-reloaded a model artifact
+serve_reload_failed       a new checkpoint generation failed to load (torn
+                          write / bad decode artifacts); the previous model
+                          keeps serving and the generation is not retried
+promotion_promoted        canary gate promoted a candidate model (scores,
+                          deltas vs the incumbent baseline, model ids)
+promotion_rejected        canary gate auto-rejected a candidate: forensics
+                          event carrying per-column deltas, the tripped
+                          quality budgets, and both model ids
 fleet_load                fleet admin loaded a tenant model
 fleet_evict               fleet admin evicted a tenant model
 tenant_shed               per-tenant admission shed requests (rate-limited
@@ -93,6 +101,7 @@ EVENT_TYPES = frozenset({
     "checkpoint", "checkpoint_restore",
     "transport_reconnect", "transport_drop", "heartbeat_lapse",
     "compile", "backend_probe", "device_trace", "serve_reload",
+    "serve_reload_failed", "promotion_promoted", "promotion_rejected",
     "fleet_load", "fleet_evict", "tenant_shed",
     "program_cost", "init_phase", "serve_stages", "init_cache",
     "client_contribution", "similarity", "slo_breach",

@@ -337,6 +337,36 @@ def summarize_many(paths: Sequence[str], on_skip=None) -> dict:
             "shed_by_tenant": dict(sorted(shed_by_tenant.items())),
         }
 
+    promos = [e for e in events
+              if e.get("type") in ("promotion_promoted",
+                                   "promotion_rejected")]
+    fails = by_type.get("serve_reload_failed", 0)
+    if promos or fails:
+        per_tenant: Dict[str, dict] = {}
+        for e in promos:
+            t = str(e.get("tenant", "?"))
+            d = per_tenant.setdefault(t, {"promotions": 0, "rejections": 0})
+            if e.get("type") == "promotion_promoted":
+                d["promotions"] += 1
+            else:
+                d["rejections"] += 1
+            if isinstance(e.get("avg_jsd"), (int, float)):
+                d["avg_jsd_last"] = round(float(e["avg_jsd"]), 6)
+            if isinstance(e.get("avg_wd"), (int, float)):
+                d["avg_wd_last"] = round(float(e["avg_wd"]), 6)
+        rejects = [e for e in promos
+                   if e.get("type") == "promotion_rejected"]
+        tripped = sorted({str(t) for e in rejects
+                          for t in (e.get("tripped") or [])})
+        out["quality"] = {
+            "promotions": by_type.get("promotion_promoted", 0),
+            "rejections": by_type.get("promotion_rejected", 0),
+            "reload_failures": fails,
+            "per_tenant": dict(sorted(per_tenant.items())),
+            "tripped_budgets": tripped,
+            "last_rejection": rejects[-1] if rejects else None,
+        }
+
     costs = [e for e in events if e.get("type") == "program_cost"]
     traces = [e for e in events if e.get("type") == "device_trace"]
     if costs or traces:
@@ -539,6 +569,32 @@ def render_text(summary: dict) -> str:
                      f"{sv['fleet_evicts']} evict(s)"
                      + (f", shed by tenant {sv['shed_by_tenant']}"
                         if sv["shed_by_tenant"] else ""))
+    q = summary.get("quality")
+    if q:
+        lines.append(f"  quality: {q['promotions']} promotion(s), "
+                     f"{q['rejections']} rejection(s), "
+                     f"{q['reload_failures']} reload failure(s)"
+                     + (f", tripped {q['tripped_budgets']}"
+                        if q["tripped_budgets"] else ""))
+        for t, d in q.get("per_tenant", {}).items():
+            scores = ""
+            if d.get("avg_jsd_last") is not None:
+                scores = (f"  avg_jsd {d['avg_jsd_last']} "
+                          f"avg_wd {d.get('avg_wd_last')}")
+            lines.append(f"    tenant {t}: {d['promotions']} promoted, "
+                         f"{d['rejections']} rejected{scores}")
+        lr = q.get("last_rejection")
+        if lr:
+            worst = sorted(
+                ((c, v) for c, v in (lr.get("per_column") or {}).items()
+                 if isinstance(v, dict)
+                 and isinstance(v.get("delta"), (int, float))),
+                key=lambda kv: (-abs(kv[1]["delta"]), kv[0]))[:3]
+            cols = ", ".join(f"{c} {v['delta']:+.4f}" for c, v in worst)
+            lines.append(f"    last rejection: candidate "
+                         f"{lr.get('candidate')} tripped "
+                         f"{lr.get('tripped')}"
+                         + (f"; worst columns: {cols}" if cols else ""))
     pg = summary.get("programs")
     if pg:
         lines.append(f"  programs: {pg['ledgered']} ledgered, "

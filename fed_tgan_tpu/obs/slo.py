@@ -31,6 +31,9 @@ inputs the events are first folded into flat figures:
   (last event per program wins)
 - ``serve_stages``  -> ``stage/<stage>/p99_ms|p50_ms`` (worst observed)
 - ``init_phase``    -> ``init/<phase>/seconds`` (summed)
+- ``promotion_promoted`` / ``promotion_rejected`` ->
+  ``quality/avg_jsd|avg_wd|jsd_delta|wd_delta|ml_acc_delta`` (worst
+  observed -- the canary gate's shadow scores)
 
 and ``metric`` is looked up as an exact figure key (program names may
 contain dots/brackets, so no dotted traversal on journal figures).
@@ -161,6 +164,17 @@ def journal_figures(events: List[dict]) -> Dict[str, float]:
             key = f"init/{phase}/seconds"
             figures[key] = figures.get(key, 0.0) + float(
                 ev.get("seconds", 0) or 0)
+        elif kind in ("promotion_promoted", "promotion_rejected"):
+            # worst observed shadow score / delta across the run; keys
+            # match the canary gate's own figure names, so the same
+            # quality/* budget rules gate live promotion AND this
+            # offline re-check of a journal
+            for k in ("avg_jsd", "avg_wd", "jsd_delta", "wd_delta",
+                      "ml_acc_delta"):
+                if isinstance(ev.get(k), (int, float)):
+                    key = f"quality/{k}"
+                    val = float(ev[k])
+                    figures[key] = max(figures.get(key, val), val)
     return figures
 
 

@@ -186,6 +186,20 @@ def _fault_hook(path: str) -> None:
         plan.on_checkpoint_write(path)
 
 
+def _snapshot_fault_hook(path: str) -> None:
+    """Post-publish fault-injection point for sampling checkpoints
+    (``degrade_snapshot``): the save has already succeeded atomically,
+    the fault mutates the published payload in place (no-op unless a
+    plan with a degrade fault is active)."""
+    try:
+        from fed_tgan_tpu.testing.faults import active_plan
+    except Exception:
+        return
+    plan = active_plan()
+    if plan is not None:
+        plan.on_snapshot_publish(path)
+
+
 def _save_leaves(tree, extra: dict, path: str) -> None:
     leaves = jax.tree.leaves(tree)
     arrays = {f"leaf_{i:05d}": np.asarray(l) for i, l in enumerate(leaves)}
@@ -374,16 +388,24 @@ class SavedSynthesizer:
 def save_synthesizer(synth, path: str) -> None:
     """Persist the sampling artifact of a trained synthesizer/trainer.
 
-    Accepts a ``StandaloneSynthesizer`` or a ``FederatedTrainer`` (which
+    Accepts a ``StandaloneSynthesizer``, a ``FederatedTrainer`` (which
     contributes its post-aggregation global generator and the pooled
-    conditional sampler, like the reference server's snapshot model).
-    Crash-safe like ``save_federated``: staged, fsynced, atomic rename.
+    conditional sampler, like the reference server's snapshot model), or
+    a ``SavedSynthesizer`` being republished (the canary helpers reload
+    an artifact, bump its ``key_offset``, and save it back as a new
+    generation).  Crash-safe like ``save_federated``: staged, fsynced,
+    atomic rename.
     """
     if hasattr(synth, "_global_model"):  # FederatedTrainer
         params_g, state_g = synth._global_model()
         cond = synth.server_cond
         transformer = synth.init.transformers[0]
         key_offset = 29  # FederatedTrainer.sample_encoded's offset
+    elif hasattr(synth, "params_g"):  # SavedSynthesizer republish
+        params_g, state_g = synth.params_g, synth.state_g
+        cond = synth.cond
+        transformer = synth.transformer
+        key_offset = synth.key_offset
     else:
         params_g, state_g = synth.models.params_g, synth.models.state_g
         cond = synth.cond
@@ -410,6 +432,7 @@ def save_synthesizer(synth, path: str) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
     _publish_dir(tmp, path, keep=1)
+    _snapshot_fault_hook(path)
 
 
 def load_synthesizer(path: str) -> SavedSynthesizer:

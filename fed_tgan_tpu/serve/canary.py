@@ -1,0 +1,561 @@
+"""Quality control plane: canaried model promotion for the serving layer.
+
+The paper evaluates synthetic-data fidelity offline (Avg_JSD on
+categoricals + Avg_WD on continuous columns, arXiv:2108.07927 §5); the
+fleet hot-reloads snapshots under fire with no check that the new
+generator is any good.  This module turns the offline analysis into a
+live promotion gate: when the registry sees a loadable new generation,
+``--promote canary`` does NOT swap — a :class:`CanaryGate` samples shadow
+rows from the candidate through the existing engine path, scores them
+against the tenant's reference statistics, and only promotes when the
+tenant's quality budgets (``obs/budgets.json``, ``quality/*`` rules)
+pass.
+
+Scoring:
+
+- **Avg_JSD** — per categorical column, Jensen–Shannon distance (base 2,
+  same as ``eval.similarity.column_similarity``) between the reference
+  frequency table and the shadow sample's, over the REFERENCE category
+  vocabulary (candidate-only categories are ignored, exactly like the
+  offline scorer).
+- **Avg_WD** — per continuous column, min-max-scaled 1-Wasserstein via
+  the ``federation/sketch.py`` mixture-CDF program: reference and shadow
+  samples become two "clients" of tiny-σ Gaussian mixtures, the pool
+  weight ω = [1, 0] pins the pooled CDF to the reference, and row 1 of
+  one :func:`~fed_tgan_tpu.federation.sketch._wd_impl` dispatch is
+  W1(candidate, reference) for every column at once — scoring is one
+  device program.
+- optional **ML-efficacy probe** — train a tiny classifier on the shadow
+  sample, evaluate accuracy on held-out real rows stored in the stats
+  artifact (the paper's "train on synthetic, test on real" protocol).
+
+Gating is DELTA-based: the candidate's scores are compared against the
+incumbent's scores over the same reference/seed (cached per model id),
+so the budgets bound *regressions*, not the absolute fidelity of a
+checkpoint that may be one epoch old.  A rejected candidate's
+fingerprint is quarantined — the same bytes are never re-scored, only a
+genuinely new generation is — and the rejection journals a
+``promotion_rejected`` forensics event carrying per-column deltas, the
+tripped budget rules, and both model ids.
+
+Reference statistics are a small JSON artifact written next to the
+checkpoint at ``--save-model`` time (``reference_stats_<name>.json``);
+for legacy artifacts the gate derives stats on demand by sampling the
+incumbent (``source: "derived_incumbent"``) — the gate then bounds drift
+relative to what is currently serving.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+
+REFERENCE_STATS_SCHEMA = 1
+
+#: default shadow-sample size: large enough that score noise sits well
+#: inside the 0.15 delta budgets, small enough to reuse the serving
+#: engine's compiled buckets in one or two dispatches
+DEFAULT_SHADOW_ROWS = 512
+
+#: per-column value subsample kept in the stats artifact (order
+#: statistics, so the subsample is a deterministic quantile sketch)
+DEFAULT_MAX_VALUES = 256
+
+#: σ of the empirical-value Gaussians, in min-max-scaled units — small
+#: enough that the mixture CDF is the empirical CDF to well under any
+#: budget, large enough to stay numerically clean on the shared grid
+_EMPIRICAL_STD = 1e-3
+
+
+# ------------------------------------------------------- reference stats
+
+
+def reference_stats_path(models_dir: str, name: str) -> str:
+    """The stats artifact lives next to the meta JSON / encoder pickle.
+
+    The ``reference_stats_`` prefix guarantees the registry's artifact
+    walk never mistakes it for a run meta: a meta JSON only counts with
+    a paired ``label_encoders_<stem>.pickle``, which this never has."""
+    return os.path.join(models_dir, f"reference_stats_{name}.json")
+
+
+def _subsample(values: np.ndarray, max_values: int) -> np.ndarray:
+    """Deterministic quantile sketch: evenly-spaced order statistics."""
+    values = np.sort(np.asarray(values, dtype=np.float64))
+    values = values[np.isfinite(values)]
+    if len(values) <= max_values:
+        return values
+    idx = np.linspace(0, len(values) - 1, max_values).round().astype(int)
+    return values[idx]
+
+
+def compute_reference_stats(frame, categorical_columns,
+                            max_values: int = DEFAULT_MAX_VALUES,
+                            probe_rows: int = 0, name: str = "",
+                            source: str = "training_data") -> dict:
+    """Distill ``frame`` into the JSON-serializable scoring reference.
+
+    ``probe_rows`` > 0 additionally stores that many (head) rows verbatim
+    for the optional ML-efficacy probe."""
+    cats = [c for c in categorical_columns if c in frame.columns]
+    stats: dict = {
+        "schema": REFERENCE_STATS_SCHEMA,
+        "name": str(name),
+        "rows": int(len(frame)),
+        "source": str(source),
+        "categorical": {},
+        "continuous": {},
+    }
+    for col in frame.columns:
+        if col in cats:
+            freqs = frame[col].astype(str).value_counts(normalize=True)
+            stats["categorical"][str(col)] = {
+                "categories": [str(c) for c in freqs.index],
+                "freqs": [float(v) for v in freqs.values],
+            }
+        else:
+            vals = np.asarray(frame[col], dtype=np.float64)
+            vals = vals[np.isfinite(vals)]
+            lo = float(vals.min()) if len(vals) else 0.0
+            hi = float(vals.max()) if len(vals) else 1.0
+            stats["continuous"][str(col)] = {
+                "min": lo,
+                "max": hi,
+                "values": [float(v) for v in _subsample(vals, max_values)],
+            }
+    if probe_rows > 0:
+        head = frame.head(int(probe_rows))
+        stats["probe"] = {
+            "columns": [str(c) for c in head.columns],
+            "rows": [[str(v) if c in cats else float(v)
+                      for c, v in zip(head.columns, row)]
+                     for row in head.itertuples(index=False)],
+        }
+    return stats
+
+
+def write_reference_stats(stats: dict, path: str) -> str:
+    """Atomic write (tmp + rename) so a reader never sees a torn file."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(stats, f)
+    os.replace(tmp, path)
+    return path
+
+
+def load_reference_stats(path: str) -> dict:
+    with open(path) as f:
+        stats = json.load(f)
+    if not isinstance(stats, dict) or "categorical" not in stats \
+            or "continuous" not in stats:
+        raise ValueError(f"{path}: not a reference-stats artifact")
+    return stats
+
+
+# ---------------------------------------------------------------- scoring
+
+
+def _wd_columns(stats: dict, frame, grid_points: int,
+                max_values: int = DEFAULT_MAX_VALUES) -> dict:
+    """Per-column min-max-scaled W1(candidate, reference), every column in
+    ONE sketch dispatch: a (2, C, K) stack of tiny-σ Gaussian mixtures
+    with pool weight ω = [1, 0], so the pooled CDF IS the reference and
+    row 1 of the result is each column's candidate-vs-reference W1."""
+    cont = stats["continuous"]
+    if not cont:
+        return {}
+    from fed_tgan_tpu.federation.sketch import _wd_fn, column_grids
+
+    cols, pairs = [], []
+    for col, info in cont.items():
+        lo, hi = float(info["min"]), float(info["max"])
+        span = (hi - lo) if hi > lo else 1.0
+        ref = (np.asarray(info["values"], dtype=np.float64) - lo) / span
+        if col in frame.columns:
+            cand = np.asarray(frame[col], dtype=np.float64)
+            cand = (cand[np.isfinite(cand)] - lo) / span
+            cand = _subsample(cand, max_values)
+        else:
+            cand = np.asarray([], dtype=np.float64)
+        cols.append(col)
+        pairs.append((ref, cand))
+    k = max(max(len(r), len(c), 1) for r, c in pairs)
+    shape = (2, len(cols), k)
+    means = np.zeros(shape)
+    stds = np.ones(shape)       # zero-weight padding keeps the CDF finite
+    weights = np.zeros(shape)
+    for j, (ref, cand) in enumerate(pairs):
+        for row, vals in ((0, ref), (1, cand)):
+            if not len(vals):
+                continue
+            means[row, j, :len(vals)] = vals
+            stds[row, j, :len(vals)] = _EMPIRICAL_STD
+            weights[row, j, :len(vals)] = 1.0 / len(vals)
+    import jax
+    import jax.numpy as jnp
+
+    omega = np.array([1.0, 0.0])
+    grid = column_grids(means, stds, weights, grid_points)
+    wd = np.asarray(jax.device_get(_wd_fn()(
+        jnp.asarray(means, jnp.float32), jnp.asarray(stds, jnp.float32),
+        jnp.asarray(weights, jnp.float32), jnp.asarray(omega, jnp.float32),
+        jnp.asarray(grid, jnp.float32),
+    )), dtype=np.float64)
+    out = {}
+    for j, (col, (_, cand)) in enumerate(zip(cols, pairs)):
+        # an empty candidate column is maximally wrong, not silently fine
+        out[col] = float(wd[1, j]) if len(cand) else 1.0
+    return out
+
+
+def score_frame(stats: dict, frame,
+                grid_points: Optional[int] = None) -> dict:
+    """Score ``frame`` against ``stats``; same units as
+    ``eval.similarity.statistical_similarity`` (JSD base 2, WD on
+    min-max-scaled values — the reference min/max, stored in the stats).
+
+    Returns ``{"avg_jsd", "avg_wd", "per_column": {col: {kind, value}}}``.
+    """
+    from scipy.spatial.distance import jensenshannon
+
+    from fed_tgan_tpu.federation.sketch import GRID_POINTS
+
+    per_column: dict = {}
+    jsd_vals = []
+    for col, info in stats["categorical"].items():
+        p = np.asarray(info["freqs"], dtype=np.float64)
+        if col in frame.columns and len(frame):
+            freqs = frame[col].astype(str).value_counts(normalize=True)
+            q = np.asarray([float(freqs.get(c, 0.0))
+                            for c in info["categories"]])
+        else:
+            q = np.zeros_like(p)
+        val = float(jensenshannon(p, q, 2.0))
+        if not np.isfinite(val):
+            val = 0.0  # identical degenerate distributions
+        per_column[col] = {"kind": "jsd", "value": val}
+        jsd_vals.append(val)
+    wd_by_col = _wd_columns(stats, frame,
+                            grid_points or GRID_POINTS)
+    for col, val in wd_by_col.items():
+        per_column[col] = {"kind": "wd", "value": val}
+    wd_vals = list(wd_by_col.values())
+    return {
+        "avg_jsd": float(np.mean(jsd_vals)) if jsd_vals else 0.0,
+        "avg_wd": float(np.mean(wd_vals)) if wd_vals else 0.0,
+        "per_column": per_column,
+    }
+
+
+def ml_efficacy_probe(stats: dict, frame) -> Optional[float]:
+    """Train-on-synthetic / test-on-real accuracy for the first
+    categorical column, against the probe rows stored in ``stats``.
+    None when the probe is not applicable (no probe rows, no categorical
+    target, sklearn unavailable, degenerate training labels)."""
+    probe = stats.get("probe")
+    if not probe or not stats["categorical"]:
+        return None
+    target = next(iter(stats["categorical"]))
+    try:
+        import pandas as pd
+        from sklearn.linear_model import LogisticRegression
+
+        real = pd.DataFrame(probe["rows"], columns=probe["columns"])
+
+        def features(df):
+            blocks = []
+            for col, info in stats["categorical"].items():
+                if col == target:
+                    continue
+                s = df[col].astype(str)
+                blocks.append(np.stack(
+                    [(s == c).to_numpy(float)
+                     for c in info["categories"]], axis=1))
+            for col, info in stats["continuous"].items():
+                lo, hi = float(info["min"]), float(info["max"])
+                span = (hi - lo) if hi > lo else 1.0
+                v = (np.asarray(df[col], dtype=np.float64) - lo) / span
+                blocks.append(np.nan_to_num(v)[:, None])
+            return np.concatenate(blocks, axis=1)
+
+        y_train = frame[target].astype(str).to_numpy()
+        if len(np.unique(y_train)) < 2:
+            return None
+        clf = LogisticRegression(max_iter=200)
+        clf.fit(features(frame), y_train)
+        y_real = real[target].astype(str).to_numpy()
+        return float(np.mean(clf.predict(features(real)) == y_real))
+    except Exception:
+        return None
+
+
+# ------------------------------------------------------------------- gate
+
+
+@dataclass
+class CanaryConfig:
+    """Knobs of one tenant's promotion gate."""
+
+    shadow_rows: int = DEFAULT_SHADOW_ROWS
+    shadow_seed: int = 0
+    grid_points: int = 0            # 0 = the sketch default (512)
+    max_values: int = DEFAULT_MAX_VALUES
+    ml_probe: bool = False          # score quality/ml_acc_delta too
+    budgets_path: Optional[str] = None   # None = obs/budgets.json
+
+
+@dataclass
+class _StatsCache:
+    key: tuple = ()
+    stats: Optional[dict] = field(default=None)
+
+
+class CanaryGate:
+    """Per-tenant promotion state machine over one registry + engine.
+
+    ``consider()`` is the canary-mode replacement for the reload poll's
+    ``maybe_reload()``: it polls for a candidate generation, scores it
+    in shadow, and either promotes it into the registry (the caller then
+    adopts, exactly like an immediate reload) or quarantines its
+    fingerprint and leaves the serving model untouched.  Never raises —
+    a failing gate must not take serving down."""
+
+    def __init__(self, registry, engine, tenant: str = "",
+                 config: Optional[CanaryConfig] = None, log=print):
+        self.registry = registry
+        self.engine = engine
+        self.tenant = tenant or registry.get().artifact.name
+        self.config = config or CanaryConfig()
+        self._log = log
+        # consider() runs on the reload thread; status() is read by HTTP
+        # handler threads — counters and the quarantine map are shared
+        self._lock = threading.Lock()
+        self._quarantine: dict = {}   # fingerprint -> rejection decision
+        self._baselines: dict = {}    # incumbent model_id -> scores
+        self._stats_cache = _StatsCache()
+        self.last_decision: Optional[dict] = None
+        self.promotions = 0
+        self.rejections = 0
+        self.scored_total = 0
+
+    # --------------------------------------------------------- reference
+
+    def _reference_stats(self, incumbent) -> dict:
+        """The artifact's stats when present (cache keyed by stat), else
+        stats derived from the incumbent's own shadow sample (legacy
+        artifacts: the gate then bounds drift vs what is serving now)."""
+        art = incumbent.artifact
+        path = reference_stats_path(art.models_dir, art.name)
+        try:
+            st = os.stat(path)
+            key = ("file", path, st.st_mtime_ns, st.st_size)
+        except OSError:
+            key = ("derived", incumbent.model_id)
+        if self._stats_cache.key == key and self._stats_cache.stats:
+            return self._stats_cache.stats
+        if key[0] == "file":
+            try:
+                stats = load_reference_stats(path)
+            except (OSError, ValueError) as exc:
+                self._log(f"canary[{self.tenant}]: unreadable reference "
+                          f"stats {path} ({exc}); deriving from incumbent")
+                key = ("derived", incumbent.model_id)
+                stats = None
+        else:
+            stats = None
+        if stats is None:
+            frame = self.engine.sample_frame(
+                self.config.shadow_rows, seed=self.config.shadow_seed,
+                snap=self.engine.snapshot())
+            stats = compute_reference_stats(
+                frame, list(incumbent.meta.categorical_columns),
+                max_values=self.config.max_values, name=art.name,
+                source="derived_incumbent")
+        self._stats_cache = _StatsCache(key=key, stats=stats)
+        return stats
+
+    def _score(self, stats: dict, snap) -> dict:
+        frame = self.engine.sample_frame(
+            self.config.shadow_rows, seed=self.config.shadow_seed,
+            snap=snap)
+        with self._lock:
+            self.scored_total += 1
+        scores = score_frame(stats, frame,
+                             grid_points=self.config.grid_points or None)
+        if self.config.ml_probe and stats.get("probe"):
+            scores["ml_acc"] = ml_efficacy_probe(stats, frame)
+        return scores
+
+    def _baseline(self, incumbent, stats: dict) -> dict:
+        cached = self._baselines.get(incumbent.model_id)
+        if cached is None:
+            cached = self._score(stats, self.engine.snapshot())
+            # one incumbent at a time: dropping the rest bounds the cache
+            self._baselines = {incumbent.model_id: cached}
+        return cached
+
+    # ------------------------------------------------------------ budgets
+
+    def _quality_rules(self) -> list:
+        from fed_tgan_tpu.obs import slo
+
+        path = self.config.budgets_path or slo.default_budgets_path()
+        try:
+            rules = slo.load_budgets(path)
+        except slo.SLOError as exc:
+            self._log(f"canary[{self.tenant}]: budgets unreadable ({exc}); "
+                      "promoting unguarded")
+            return []
+        out = []
+        for rule in rules:
+            if not str(rule.get("metric", "")).startswith("quality/"):
+                continue
+            sel = (rule.get("select") or {}).get("tenant")
+            if sel and sel not in ("*", self.tenant):
+                continue
+            out.append(rule)
+        return out
+
+    @staticmethod
+    def _tripped(figures: dict, rules: list) -> list:
+        tripped = []
+        for rule in rules:
+            value = figures.get(rule["metric"])
+            if value is None:
+                continue
+            name = rule.get("name", rule["metric"])
+            if "max" in rule and value > float(rule["max"]):
+                tripped.append(name)
+            elif "min" in rule and value < float(rule["min"]):
+                tripped.append(name)
+        return tripped
+
+    # ----------------------------------------------------------- decision
+
+    def consider(self) -> Optional[dict]:
+        """One promotion poll.  Returns None when there is nothing new to
+        decide (no candidate, or a quarantined/unloadable one), else the
+        decision dict (``decision["promoted"]`` tells the caller whether
+        to adopt the registry's new model)."""
+        cand = self.registry.poll_candidate()
+        if cand is None:
+            return None
+        if cand.fingerprint in self._quarantine:
+            # the same rejected bytes re-published (or re-statted): skip
+            # without re-scoring — the no-retry-storm contract
+            self.registry.dismiss(cand)
+            return None
+        t0 = time.time()
+        incumbent = self.registry.get()
+        try:
+            model = self.registry.load_candidate(cand)
+        except Exception as exc:  # noqa: BLE001 — torn candidate
+            self._log(f"canary[{self.tenant}]: candidate "
+                      f"{cand.fingerprint} failed to load ({exc!r})")
+            _emit_event("serve_reload_failed", tenant=self.tenant,
+                        model_id=incumbent.model_id, error=repr(exc))
+            self.registry.dismiss(cand)
+            return None
+        try:
+            stats = self._reference_stats(incumbent)
+            base = self._baseline(incumbent, stats)
+            scores = self._score(stats, self.engine.shadow_snapshot(model))
+        except Exception as exc:  # noqa: BLE001 — a candidate that cannot
+            # be shadow-sampled is rejected, never promoted on faith
+            return self._reject(cand, incumbent, None, None,
+                                ["shadow_error"], t0, error=repr(exc))
+        figures = {
+            "quality/avg_jsd": scores["avg_jsd"],
+            "quality/avg_wd": scores["avg_wd"],
+            "quality/jsd_delta": scores["avg_jsd"] - base["avg_jsd"],
+            "quality/wd_delta": scores["avg_wd"] - base["avg_wd"],
+        }
+        if scores.get("ml_acc") is not None \
+                and base.get("ml_acc") is not None:
+            figures["quality/ml_acc_delta"] = base["ml_acc"] - scores["ml_acc"]
+        tripped = self._tripped(figures, self._quality_rules())
+        if tripped:
+            return self._reject(cand, incumbent, scores, base, tripped, t0,
+                                figures=figures)
+        self.registry.promote(model, cand)
+        # the candidate is the incumbent now; its scores are the next
+        # baseline for free (same stats, same shadow seed)
+        self._baselines = {model.model_id: scores}
+        with self._lock:
+            self.promotions += 1
+        decision = self._decision(True, cand, incumbent, scores, base,
+                                  [], t0, figures=figures)
+        _emit_event("promotion_promoted", **decision)
+        self._log(f"canary[{self.tenant}]: promoted {cand.fingerprint} "
+                  f"(jsd_delta={figures['quality/jsd_delta']:+.4f} "
+                  f"wd_delta={figures['quality/wd_delta']:+.4f})")
+        self.last_decision = decision
+        return decision
+
+    def _decision(self, promoted: bool, cand, incumbent, scores, base,
+                  tripped: list, t0: float, figures: Optional[dict] = None,
+                  error: Optional[str] = None) -> dict:
+        per_column = {}
+        if scores is not None and base is not None:
+            for col, cur in scores["per_column"].items():
+                b = base["per_column"].get(col, {}).get("value", 0.0)
+                per_column[col] = {
+                    "kind": cur["kind"],
+                    "candidate": round(cur["value"], 6),
+                    "baseline": round(b, 6),
+                    "delta": round(cur["value"] - b, 6),
+                }
+        decision = {
+            "promoted": promoted,
+            "tenant": self.tenant,
+            "candidate": cand.fingerprint,
+            "model_id": incumbent.model_id,
+            "tripped": list(tripped),
+            "per_column": per_column,
+            "seconds": round(time.time() - t0, 3),
+        }
+        if scores is not None:
+            decision["avg_jsd"] = round(scores["avg_jsd"], 6)
+            decision["avg_wd"] = round(scores["avg_wd"], 6)
+        for key, val in (figures or {}).items():
+            decision[key.split("/", 1)[1]] = round(val, 6)
+        if error is not None:
+            decision["error"] = error
+        return decision
+
+    def _reject(self, cand, incumbent, scores, base, tripped: list,
+                t0: float, figures: Optional[dict] = None,
+                error: Optional[str] = None) -> dict:
+        decision = self._decision(False, cand, incumbent, scores, base,
+                                  tripped, t0, figures=figures, error=error)
+        with self._lock:
+            self._quarantine[cand.fingerprint] = decision
+            self.rejections += 1
+        self.registry.dismiss(cand)
+        _emit_event("promotion_rejected", **decision)
+        self._log(f"canary[{self.tenant}]: REJECTED candidate "
+                  f"{cand.fingerprint} (tripped: {', '.join(tripped)}); "
+                  f"keeping {incumbent.model_id}")
+        self.last_decision = decision
+        return decision
+
+    # ------------------------------------------------------------- status
+
+    def status(self) -> dict:
+        """Candidate/promotion state for /healthz and /fleet."""
+        with self._lock:
+            return {
+                "mode": "canary",
+                "promotions": self.promotions,
+                "rejections": self.rejections,
+                "quarantined": sorted(self._quarantine),
+                "last_decision": self.last_decision,
+            }

@@ -75,4 +75,32 @@ def build_demo_artifact(out_dir: str, rows: int = 200, seed: int = 0,
         pickle.dump(
             encoder_artifact(table_meta.categorical_columns, encoders), f)
     save_synthesizer(synth, os.path.join(models_dir, "synthesizer"))
+    # reference statistics for the canary promotion gate: scored against
+    # shadow samples from candidate checkpoints at serve time
+    from fed_tgan_tpu.serve.canary import (compute_reference_stats,
+                                           reference_stats_path,
+                                           write_reference_stats)
+
+    stats = compute_reference_stats(
+        pre.frame, table_meta.categorical_columns, name=name,
+        probe_rows=min(64, rows))
+    write_reference_stats(stats, reference_stats_path(models_dir, name))
     return out_dir
+
+
+def republish_demo_candidate(artifact_dir: str,
+                             key_offset_bump: int = 1000) -> str:
+    """Republish the artifact's synthesizer as a NEW generation with the
+    same learned parameters but a bumped sampling-key offset: a fresh
+    checkpoint fingerprint whose output distribution is identical in
+    law.  The canary gate should always promote it — tests, the bench
+    canary workload, and the doctor all use this as the 'clean
+    candidate' against the degraded one."""
+    from fed_tgan_tpu.runtime.checkpoint import (load_synthesizer,
+                                                 save_synthesizer)
+
+    path = os.path.join(artifact_dir, "models", "synthesizer")
+    synth = load_synthesizer(path)
+    synth.key_offset += int(key_offset_bump)
+    save_synthesizer(synth, path)
+    return path

@@ -231,6 +231,28 @@ class SamplingEngine:
                                   self._layout, self._tables, self._sig,
                                   self._tag)
 
+    def shadow_snapshot(self, model: LoadedModel) -> EngineSnapshot:
+        """A snapshot over a CANDIDATE model, without adopting it — the
+        canary gate samples shadow rows through the exact serving path
+        while every serving field stays untouched.  A candidate with the
+        serving layout (the common keep-training case) reuses the serving
+        sig/tag and therefore every compiled bucket program — zero extra
+        compiles; a different layout gets its own tag so shadow programs
+        never collide with serving ones under the sanitizer's
+        one-compile-per-name budget."""
+        import jax
+
+        from fed_tgan_tpu.ops.decode import decode_layout, decode_tables
+
+        sig = self.layout_key(model)
+        columns = model.synth.transformer.columns
+        layout = decode_layout(columns)
+        tables = jax.device_put(decode_tables(columns))
+        with self._lock:
+            tag = self._tag if sig == self._sig else layout_tag(sig)
+        return EngineSnapshot(model, model.synth.spec, model.synth.cfg,
+                              layout, tables, sig, tag)
+
     def _program(self, snap: EngineSnapshot, n_steps: int,
                  conditional: bool):
         key = (n_steps, conditional, snap.sig)

@@ -244,6 +244,8 @@ class TenantRuntime:
     registry: ModelRegistry
     engine: SamplingEngine
     bucket: TokenBucket
+    # canary promotion gate (None under the default immediate policy)
+    gate: object = None
 
 
 class FleetRegistry:
@@ -259,13 +261,15 @@ class FleetRegistry:
     def __init__(self, program_cache: Optional[ProgramCache] = None,
                  quota_rps: float = 0.0, quota_burst: Optional[float] = None,
                  max_chunk_steps: int = 128,
-                 allow_meta_mismatch: bool = False, log=print):
+                 allow_meta_mismatch: bool = False,
+                 promote: str = "immediate", log=print):
         self.cache = program_cache if program_cache is not None \
             else ProgramCache()
         self.quota_rps = float(quota_rps)
         self.quota_burst = quota_burst
         self.max_chunk_steps = int(max_chunk_steps)
         self.allow_meta_mismatch = allow_meta_mismatch
+        self.promote = str(promote)
         self._log = log
         self._lock = threading.RLock()
         self._tenants: OrderedDict = OrderedDict()  # name -> TenantRuntime
@@ -280,9 +284,15 @@ class FleetRegistry:
         model = registry.get()  # eager: fail here, not on first request
         engine = SamplingEngine(model, max_chunk_steps=self.max_chunk_steps,
                                 program_cache=self.cache)
+        gate = None
+        if self.promote == "canary":
+            from fed_tgan_tpu.serve.canary import CanaryGate
+
+            gate = CanaryGate(registry, engine, tenant=name, log=self._log)
         rt = TenantRuntime(
             name=name, root=str(root), registry=registry, engine=engine,
             bucket=TokenBucket(self.quota_rps, self.quota_burst),
+            gate=gate,
         )
         with self._lock:
             self._tenants[name] = rt
@@ -930,7 +940,18 @@ class FleetService:
         self._last_reload_check = now
         for name, rt in self.fleet.items():
             try:
-                if rt.registry.maybe_reload():
+                if rt.gate is not None:
+                    decision = rt.gate.consider()
+                    if decision is None:
+                        continue
+                    self.metrics.quality.record_scores(
+                        name, decision.get("avg_jsd"),
+                        decision.get("avg_wd"))
+                    self.metrics.quality.record_decision(
+                        name, bool(decision.get("promoted")))
+                    if not decision.get("promoted"):
+                        continue  # old model keeps serving untouched
+                if rt.gate is not None or rt.registry.maybe_reload():
                     kept = rt.engine.adopt(rt.registry.get())
                     if self.row_pool is not None:
                         # pooled segments belong to the OLD model; a hit
@@ -957,14 +978,17 @@ class FleetService:
             model = rt.registry.get()
             with self._adm_lock:
                 inflight = self._inflight.get(name, 0)
-            tenants.append({
+            entry = {
                 "name": name,
                 "root": rt.root,
                 "model_id": model.model_id,
                 "model_name": model.artifact.name,
                 "inflight": inflight,
                 **self.metrics.tenant_snapshot(name),
-            })
+            }
+            if rt.gate is not None:
+                entry["promotion"] = rt.gate.status()
+            tenants.append(entry)
         return {
             "status": "draining" if self._draining.is_set() else "ok",
             "tenants": tenants,
@@ -1249,6 +1273,13 @@ def fleet_main(argv=None) -> int:
     ap.add_argument("--reload-interval", type=float, default=5.0,
                     help="seconds between per-tenant hot-reload polls "
                          "(0 = never)")
+    ap.add_argument("--promote", choices=("canary", "immediate"),
+                    default="immediate",
+                    help="new-generation policy: immediate = hot-swap any "
+                         "loadable checkpoint (default); canary = shadow-"
+                         "score each tenant's candidate against its "
+                         "reference statistics and promote only inside "
+                         "the quality budgets in obs/budgets.json")
     ap.add_argument("--allow-meta-mismatch", action="store_true",
                     help="serve even when a meta JSON postdates its "
                          "synthesizer (see --sample-from)")
@@ -1279,7 +1310,8 @@ def fleet_main(argv=None) -> int:
                                    max_bytes=int(args.cache_mb * 1024
                                                  * 1024)),
         quota_rps=args.quota_rps, quota_burst=args.quota_burst,
-        allow_meta_mismatch=args.allow_meta_mismatch, log=log,
+        allow_meta_mismatch=args.allow_meta_mismatch,
+        promote=args.promote, log=log,
     )
     for name, root in pairs:
         try:

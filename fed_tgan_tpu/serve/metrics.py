@@ -59,6 +59,74 @@ class DrainRate:
             return self._rate
 
 
+class QualityStore:
+    """Per-tenant canary quality state behind ``/metrics`` and status
+    endpoints.
+
+    Kept as a plain locked dict rather than registry gauges: the ISSUE-16
+    contract names the series ``fed_tgan_quality_{jsd,wd}{tenant=...}``
+    with no service prefix (the same names whether the single-model
+    service or the fleet exports them), while the obs registry renders
+    bare metric names and :class:`ServiceMetrics` renders from its
+    snapshot — so both hosts append these lines manually."""
+
+    def __init__(self):
+        # re-entrant: _state takes it again under the recording methods
+        self._lock = threading.RLock()
+        self._tenants: dict = {}  # tenant -> state dict
+
+    def _state(self, tenant: str) -> dict:
+        with self._lock:
+            state = self._tenants.get(tenant)
+            if state is None:
+                state = {"avg_jsd": None, "avg_wd": None,
+                         "promotions": 0, "rejections": 0}
+                self._tenants[tenant] = state
+            return state
+
+    def record_scores(self, tenant: str, avg_jsd, avg_wd) -> None:
+        """Latest shadow-scored candidate quality for ``tenant``."""
+        if avg_jsd is None or avg_wd is None:
+            return
+        with self._lock:
+            state = self._state(tenant)
+            state["avg_jsd"] = float(avg_jsd)
+            state["avg_wd"] = float(avg_wd)
+
+    def record_decision(self, tenant: str, promoted: bool) -> None:
+        with self._lock:
+            state = self._state(tenant)
+            state["promotions" if promoted else "rejections"] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {tenant: dict(state)
+                    for tenant, state in sorted(self._tenants.items())}
+
+    def render_prometheus(self) -> str:
+        """The per-tenant quality series, fixed base names (no service
+        prefix): ``fed_tgan_quality_jsd{tenant=...}`` etc.  Empty string
+        while no canary decision has been scored (immediate-mode output
+        stays byte-identical)."""
+        snap = self.snapshot()
+        if not snap:
+            return ""
+        lines = []
+        for key, kind in (("jsd", "gauge"), ("wd", "gauge"),
+                          ("promotions_total", "counter"),
+                          ("rejections_total", "counter")):
+            field = {"jsd": "avg_jsd", "wd": "avg_wd"}.get(key, key[:-6])
+            series = [(t, s[field]) for t, s in snap.items()
+                      if s[field] is not None]
+            if not series:
+                continue
+            lines.append(f"# TYPE fed_tgan_quality_{key} {kind}")
+            lines.extend(
+                f'fed_tgan_quality_{key}{{tenant="{t}"}} {v:g}'
+                for t, v in series)
+        return "\n".join(lines) + "\n" if lines else ""
+
+
 def _quantile(sorted_vals: list, q: float) -> float:
     """Nearest-rank quantile on an already-sorted list."""
     if not sorted_vals:
@@ -117,6 +185,9 @@ class ServiceMetrics:
                 reservoir=reservoir, labels={"stage": stage})
             for stage in STAGES
         }
+        # canary promotion state (empty — and invisible in every export —
+        # unless a gate records into it)
+        self.quality = QualityStore()
 
     # ------------------------------------------------- attribute compat
     # pre-registry callers read these as plain ints
@@ -223,7 +294,7 @@ class ServiceMetrics:
             for stage, st in stages.items():
                 lines.append(f'{prefix}_stage_p50_ms{{stage="{stage}"}} '
                              f"{st['p50_ms']}")
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n" + self.quality.render_prometheus()
 
 
 class FleetMetrics:
@@ -282,6 +353,9 @@ class FleetMetrics:
             for key in ("keys", "chunks", "rows", "hits", "misses",
                         "fills", "evictions")
         }
+        # canary promotion state, same fixed-name series as the
+        # single-model service exports (see QualityStore)
+        self.quality = QualityStore()
 
     def _bundle(self, tenant: str) -> dict:
         with self._tlock:
@@ -458,4 +532,5 @@ class FleetMetrics:
                 f"# TYPE {prefix}_uptime_s gauge\n"
                 f"{prefix}_uptime_s "
                 f"{max(time.time() - self.started_at, 0.0):g}\n")
-        return head + self.registry.render_prometheus()
+        return (head + self.registry.render_prometheus()
+                + self.quality.render_prometheus())

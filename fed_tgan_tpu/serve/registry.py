@@ -241,9 +241,85 @@ class ModelRegistry:
         except Exception as exc:  # torn write raced past the validity probe
             self._log(f"registry: reload failed ({exc!r}); keeping "
                       f"{self._model.model_id}")
+            # remember the failed generation like the ArtifactError branch:
+            # without this a persistently-torn candidate is re-loaded and
+            # re-logged on EVERY poll (the next genuinely-new publish moves
+            # the signature again and retries)
+            self._stat_sig = sig
+            from fed_tgan_tpu.obs.journal import emit as _emit_event
+
+            _emit_event("serve_reload_failed",
+                        model_id=self._model.model_id, error=repr(exc))
             return False
         self._log(f"registry: hot-reload {self._model.model_id} -> "
                   f"{model.model_id}")
         self._model = model
         self._stat_sig = sig
         return True
+
+    # ------------------------------------------------- canaried promotion
+    # the canary gate splits maybe_reload's walk into poll / load /
+    # promote-or-dismiss steps so a candidate can be SCORED before (or
+    # instead of) being installed; maybe_reload itself is untouched — the
+    # default --promote immediate path stays byte-identical
+
+    def poll_candidate(self) -> "CandidateInfo | None":
+        """A loadable-looking new generation, without installing it.
+
+        Same stat-signature / validity / fingerprint walk as
+        :meth:`maybe_reload`, stopping before the load: returns None when
+        nothing new landed (identical-bytes rewrites advance the stat
+        signature exactly like ``maybe_reload`` does)."""
+        if self._model is None:
+            return None
+        try:
+            art = resolve_artifact(self.root, log=lambda *_: None)
+        except ArtifactError:
+            return None
+        sig = self._stat_signature(art)
+        if sig == self._stat_sig:
+            return None
+        from fed_tgan_tpu.runtime.checkpoint import (
+            _is_valid_checkpoint,
+            checkpoint_fingerprint,
+        )
+
+        if not _is_valid_checkpoint(art.synth_dir):
+            return None  # mid-publish: catch it on the next poll
+        try:
+            fingerprint = checkpoint_fingerprint(art.synth_dir)
+        except OSError:
+            return None  # torn mid-read; next poll
+        if fingerprint == self._model.model_id:
+            self._stat_sig = sig  # rewrite of identical bytes
+            return None
+        return CandidateInfo(artifact=art, sig=sig, fingerprint=fingerprint)
+
+    def load_candidate(self, cand: "CandidateInfo") -> LoadedModel:
+        """Fully load a polled candidate (raises on torn/mismatched
+        artifacts — the gate turns that into a dismissal, not a crash)."""
+        check_meta_freshness(cand.artifact, allow=self.allow_meta_mismatch,
+                             log=self._log)
+        return load_model(cand.artifact)
+
+    def promote(self, model: LoadedModel, cand: "CandidateInfo") -> None:
+        """Install a gate-approved candidate as the serving model."""
+        self._log(f"registry: promote {self._model.model_id} -> "
+                  f"{model.model_id}")
+        self._model = model
+        self._stat_sig = cand.sig
+
+    def dismiss(self, cand: "CandidateInfo") -> None:
+        """Remember a rejected/unloadable candidate's signature so the
+        same bytes are not re-examined every poll — only a genuinely new
+        publish moves the signature again."""
+        self._stat_sig = cand.sig
+
+
+@dataclass(frozen=True)
+class CandidateInfo:
+    """One polled-but-not-installed checkpoint generation."""
+
+    artifact: ResolvedArtifact
+    sig: tuple
+    fingerprint: str

@@ -75,10 +75,23 @@ class SamplingService:
                  port: int = 0, max_batch: int = 8, queue_size: int = 64,
                  request_timeout_s: float = 120.0,
                  reload_interval_s: float = 5.0, workers: int = 1,
-                 coalesce_window_s: float = 0.0, log=print):
+                 coalesce_window_s: float = 0.0, promote: str = "immediate",
+                 canary_config=None, log=print):
         self.registry = registry
         self.engine = SamplingEngine(registry.get())
         self.metrics = ServiceMetrics()
+        # promotion policy: "immediate" hot-swaps any loadable new
+        # generation (historical behaviour); "canary" shadow-scores the
+        # candidate against the tenant's reference statistics first and
+        # only promotes inside the quality budgets
+        self.promote_mode = str(promote)
+        self.gate = None
+        if self.promote_mode == "canary":
+            from fed_tgan_tpu.serve.canary import CanaryGate
+
+            self.gate = CanaryGate(registry, self.engine,
+                                   tenant=registry.get().artifact.name,
+                                   config=canary_config, log=log)
         self.max_batch = max(1, int(max_batch))
         self.request_timeout_s = request_timeout_s
         self.reload_interval_s = reload_interval_s
@@ -302,6 +315,9 @@ class SamplingService:
         if now - self._last_reload_check < self.reload_interval_s:
             return
         self._last_reload_check = now
+        if self.gate is not None:
+            self._canary_reload()
+            return
         try:
             if self.registry.maybe_reload():
                 kept = self.engine.adopt(self.registry.get())
@@ -316,6 +332,34 @@ class SamplingService:
                 )
         except Exception as exc:  # noqa: BLE001 — reload must never kill serving
             self._log(f"service: reload check failed ({exc!r})")
+
+    def _canary_reload(self) -> None:
+        """Canary promotion path: shadow-score before any swap.  The
+        serving model is only replaced after the gate promotes, so a
+        rejected candidate never contributes a byte to any response."""
+        try:
+            decision = self.gate.consider()
+        except Exception as exc:  # noqa: BLE001 — gate must never kill serving
+            self._log(f"service: canary check failed ({exc!r})")
+            return
+        if decision is None:
+            return
+        tenant = self.registry.get().artifact.name
+        self.metrics.quality.record_scores(
+            tenant, decision.get("avg_jsd"), decision.get("avg_wd"))
+        self.metrics.quality.record_decision(
+            tenant, bool(decision.get("promoted")))
+        if decision.get("promoted"):
+            kept = self.engine.adopt(self.registry.get())
+            self.metrics.record_reload()
+            _emit_event("serve_reload",
+                        model_id=self.registry.get().model_id,
+                        programs_kept=bool(kept))
+            self._log(
+                f"service: canary promoted model "
+                f"{self.registry.get().model_id} "
+                f"({'programs kept' if kept else 'programs rebuilt'})"
+            )
 
 
 def _make_handler(service: SamplingService):
@@ -356,6 +400,8 @@ def _make_handler(service: SamplingService):
                     "model_name": model.artifact.name,
                     **snap,
                     "stages": service.metrics.stage_snapshot(),
+                    "promotion": (service.gate.status() if service.gate
+                                  else {"mode": service.promote_mode}),
                 })
             elif parsed.path == "/metrics":
                 text = service.metrics.render_prometheus(
@@ -456,6 +502,13 @@ def serve_main(argv=None) -> int:
                     help="seconds a request may wait before 504")
     ap.add_argument("--reload-interval", type=float, default=5.0,
                     help="seconds between hot-reload polls (0 = never)")
+    ap.add_argument("--promote", choices=("canary", "immediate"),
+                    default="immediate",
+                    help="new-generation policy: immediate = hot-swap any "
+                         "loadable checkpoint (default); canary = shadow-"
+                         "score the candidate against the reference "
+                         "statistics and promote only inside the quality "
+                         "budgets in obs/budgets.json")
     ap.add_argument("--allow-meta-mismatch", action="store_true",
                     help="serve even when the meta JSON postdates the "
                          "synthesizer (see --sample-from)")
@@ -485,7 +538,8 @@ def serve_main(argv=None) -> int:
             max_batch=args.max_batch, queue_size=args.queue_size,
             request_timeout_s=args.request_timeout,
             reload_interval_s=args.reload_interval, workers=args.workers,
-            coalesce_window_s=args.coalesce_window, log=log,
+            coalesce_window_s=args.coalesce_window, promote=args.promote,
+            log=log,
         )
     except ArtifactError as exc:
         print(f"serve: {exc}")

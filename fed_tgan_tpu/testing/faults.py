@@ -30,6 +30,14 @@ A ``FaultPlan`` describes failures to inject at exact, reproducible points:
   process is silently truncated after the atomic publish (default the
   first), simulating bit-rot on the onboarding cache volume; the digest
   manifest must catch it on the next read and force a refit.
+- ``degrade_snapshot:factor=F[,nth=N]`` (bare ``degrade_snapshot:100``
+  reads F positionally, like ``scale_update``) — the ``N``-th published
+  generator checkpoint (``save_synthesizer``, default the first) is
+  degraded IN PLACE on disk: its first 2-D float parameter leaf is
+  scaled by ``F``.  The checkpoint stays structurally valid (it loads,
+  its fingerprint changes), so only quality scoring — the canary
+  promotion gate — can catch it; this is the drift/corruption shape the
+  quality control plane exists to auto-reject.
 - ``straggle:rank=R,delay=D[,round=E][,until=U]`` — client ``R`` (1-based)
   is a scripted straggler over rounds [E, U]: under buffered aggregation
   (``TrainConfig.aggregation="buffered"``) it sits out each round's
@@ -87,15 +95,18 @@ class FaultPlan:
     straggle_round: int = 1     # first straggling round (1-based)
     straggle_until: int = 0     # last straggling round (0 = forever)
     corrupt_cache_nth: int = 0  # 0 = no cache-corruption fault
+    degrade_factor: float = 0.0  # 0 = no snapshot-degrade fault
+    degrade_nth: int = 1        # which published snapshot to degrade
 
-    VALID_KINDS = ("corrupt_cache", "crash_checkpoint", "delay_msg",
-                   "kill_client", "nan_update", "scale_update",
+    VALID_KINDS = ("corrupt_cache", "crash_checkpoint", "degrade_snapshot",
+                   "delay_msg", "kill_client", "nan_update", "scale_update",
                    "sever_conn", "straggle", "stuck_update")
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
         self._save_calls = 0
         self._cache_stores = 0
+        self._snapshot_saves = 0
         self._severed = False
         self._killed = False
 
@@ -104,10 +115,19 @@ class FaultPlan:
         plan = cls()
         for part in filter(None, (p.strip() for p in spec.split(";"))):
             name, _, argstr = part.partition(":")
+            if name not in cls.VALID_KINDS:
+                # fail fast BEFORE arg parsing: a typo like 'nan_updat'
+                # must not silently no-op, and a typo'd kind with a
+                # positional factor ('scale_updat:100') must name the
+                # real problem, not die on int('')
+                raise ValueError(
+                    f"unknown fault {name!r} in spec {spec!r}; valid "
+                    f"kinds: {', '.join(cls.VALID_KINDS)}"
+                )
             args = {}
             for kv in filter(None, (a.strip() for a in argstr.split(","))):
                 k, eq, v = kv.partition("=")
-                if not eq and name == "scale_update":
+                if not eq and name in ("scale_update", "degrade_snapshot"):
                     # reference-style positional factor: scale_update:100
                     args["factor"] = float(k)
                     continue
@@ -125,6 +145,17 @@ class FaultPlan:
                 plan.crash_save = args.get("save", 1)
             elif name == "corrupt_cache":
                 plan.corrupt_cache_nth = int(args.get("nth", 1))
+            elif name == "degrade_snapshot":
+                if "factor" not in args:
+                    # fail fast like the unknown-kind check: a factorless
+                    # degrade fault would silently no-op
+                    raise ValueError(
+                        f"degrade_snapshot needs a factor in spec {spec!r} "
+                        "(degrade_snapshot:100 or degrade_snapshot:"
+                        "factor=100)"
+                    )
+                plan.degrade_factor = float(args["factor"])
+                plan.degrade_nth = int(args.get("nth", 1))
             elif name == "straggle":
                 plan.straggle_rank = int(args["rank"])
                 plan.straggle_delay = max(1, int(args.get("delay", 1)))
@@ -136,11 +167,10 @@ class FaultPlan:
                 plan.update_factor = float(args.get("factor", 1.0))
                 plan.update_round = int(args.get("round", 1))
                 plan.update_until = int(args.get("until", 0))
-            else:
-                # fail fast: a typo like 'nan_updat' must not silently no-op
+            else:  # a kind in VALID_KINDS with no dispatch branch
                 raise ValueError(
-                    f"unknown fault {name!r} in spec {spec!r}; valid kinds: "
-                    f"{', '.join(cls.VALID_KINDS)}"
+                    f"fault kind {name!r} is valid but unhandled — "
+                    "parse() dispatch is missing a branch"
                 )
         return plan
 
@@ -199,6 +229,24 @@ class FaultPlan:
             f.truncate(max(1, size // 2))
         return True
 
+    def on_snapshot_publish(self, path: str) -> bool:
+        """Called after ``save_synthesizer`` publishes a sampling
+        checkpoint; degrades the ``nth`` published one in place (the save
+        itself reports success and the artifact stays loadable — only the
+        canary's quality scoring can catch the damage).  Returns True
+        when the fault fired."""
+        if self.degrade_factor == 0.0:
+            return False
+        with self._lock:
+            self._snapshot_saves += 1
+            fire = self._snapshot_saves == self.degrade_nth
+        if not fire:
+            return False
+        log.warning("FAULT: degrading published snapshot #%d by x%g (%s)",
+                    self.degrade_nth, self.degrade_factor, path)
+        degrade_checkpoint(path, self.degrade_factor)
+        return True
+
 
 def update_fault_window(
     plan: Optional[FaultPlan], e0: int, size: int
@@ -252,6 +300,38 @@ def straggle_window(
     straggler = ((plan.straggle_rank - 1, plan.straggle_delay)
                  if active else None)
     return straggler, size
+
+
+def degrade_checkpoint(path: str, factor: float) -> str:
+    """Deterministically degrade a published generator checkpoint in place.
+
+    Scales the FIRST 2-D float leaf in ``arrays.npz`` (the generator's
+    first dense kernel — ``params_g`` leaves flatten first, and the
+    conditional sampler's probability tables come later) by ``factor``
+    and rewrites the archive.  No randomness, no truncation: the
+    checkpoint remains structurally valid and loadable with a NEW
+    content fingerprint, so the serving registry sees a legitimate new
+    generation whose outputs are garbage — exactly the shape the canary
+    gate must auto-reject.  Returns the rewritten npz path.
+    """
+    import numpy as np
+
+    npz = os.path.join(path, "arrays.npz")
+    with np.load(npz) as z:
+        data = {k: z[k] for k in z.files}
+    for key in sorted(data):
+        arr = data[key]
+        if key.startswith("leaf_") and arr.ndim == 2 \
+                and np.issubdtype(arr.dtype, np.floating):
+            data[key] = (arr * factor).astype(arr.dtype)
+            break
+    else:
+        raise ValueError(f"{npz}: no 2-D float leaf to degrade")
+    with open(npz, "wb") as f:
+        np.savez(f, **data)
+        f.flush()
+        os.fsync(f.fileno())
+    return npz
 
 
 _active: Optional[FaultPlan] = None

@@ -1,0 +1,398 @@
+"""Canaried model promotion: the quality control plane.
+
+A degraded checkpoint generation must be auto-rejected — zero candidate
+bytes reach clients, the previous model keeps serving, and the rejection
+journals a forensics event — while a clean generation under ``--promote
+canary`` serves bytes bit-identical to the default immediate swap.
+Hermetic like test_serve: one demo artifact per module, ephemeral ports,
+no sleeps (reload polls are driven synchronously).
+"""
+
+import json
+import os
+import shutil
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.obs import journal as jr
+from fed_tgan_tpu.serve.canary import (
+    CanaryConfig,
+    CanaryGate,
+    compute_reference_stats,
+    load_reference_stats,
+    reference_stats_path,
+    score_frame,
+)
+from fed_tgan_tpu.serve.registry import ModelRegistry
+from fed_tgan_tpu.serve.service import SamplingService
+from fed_tgan_tpu.testing.faults import (
+    FaultPlan,
+    degrade_checkpoint,
+    install_plan,
+)
+
+pytestmark = pytest.mark.canary
+
+_silent = lambda *a, **k: None  # noqa: E731
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(tmp_path_factory):
+    from fed_tgan_tpu.serve.demo import build_demo_artifact
+
+    return build_demo_artifact(str(tmp_path_factory.mktemp("canary_artifact")))
+
+
+def _get(url, timeout=120):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _canary_service(root):
+    return SamplingService(
+        ModelRegistry(root, log=_silent), port=0, max_batch=4,
+        queue_size=32, promote="canary",
+        canary_config=CanaryConfig(shadow_rows=256), log=_silent,
+    ).start()
+
+
+def _force_poll(svc):
+    """Drive one reload/promotion poll synchronously — no sleeps."""
+    svc._last_reload_check = float("-inf")
+    svc._maybe_reload()
+
+
+# -------------------------------------------------------- reference stats
+
+
+def test_build_demo_artifact_writes_reference_stats(artifact_dir):
+    path = reference_stats_path(
+        os.path.join(artifact_dir, "models"), "demo")
+    stats = load_reference_stats(path)
+    assert stats["source"] == "training_data"
+    assert sorted(stats["categorical"]) == ["color", "flag"]
+    assert sorted(stats["continuous"]) == ["amount", "score"]
+    amount = stats["continuous"]["amount"]
+    assert amount["min"] < amount["max"]
+    assert len(amount["values"]) > 0
+    assert len(stats["probe"]["rows"]) > 0
+
+
+def test_score_frame_self_score_near_zero_and_orders_shift():
+    from fed_tgan_tpu.serve.demo import demo_frame
+
+    frame = demo_frame(rows=400, seed=3)
+    stats = compute_reference_stats(frame, ["color", "flag"])
+    own = score_frame(stats, frame)
+    assert own["avg_jsd"] == pytest.approx(0.0, abs=1e-9)
+    assert own["avg_wd"] < 0.05
+    assert set(own["per_column"]) == {"amount", "score", "color", "flag"}
+
+    shifted = frame.copy()
+    shifted["amount"] = shifted["amount"] + 1000.0
+    bad = score_frame(stats, shifted)
+    assert bad["avg_wd"] > own["avg_wd"]
+    assert bad["per_column"]["amount"]["value"] > 0.5
+
+    # a missing continuous column is maximally wrong, not silently fine
+    dropped = score_frame(stats, frame.drop(columns=["score"]))
+    assert dropped["per_column"]["score"]["value"] == 1.0
+
+
+# ------------------------------------------------------ degrade fault kind
+
+
+def test_degrade_checkpoint_valid_but_new_fingerprint(artifact_dir,
+                                                      tmp_path):
+    from fed_tgan_tpu.runtime.checkpoint import (
+        _is_valid_checkpoint,
+        checkpoint_fingerprint,
+    )
+    from fed_tgan_tpu.serve.registry import load_model, resolve_artifact
+
+    root = str(tmp_path / "artifact")
+    shutil.copytree(artifact_dir, root)
+    synth_dir = os.path.join(root, "models", "synthesizer")
+    before = checkpoint_fingerprint(synth_dir)
+    degrade_checkpoint(synth_dir, 100.0)
+    # structurally VALID — only quality scoring can catch the damage
+    assert _is_valid_checkpoint(synth_dir)
+    assert checkpoint_fingerprint(synth_dir) != before
+    load_model(resolve_artifact(root, log=_silent))  # still loads
+
+
+def test_degrade_snapshot_fault_parsing():
+    plan = FaultPlan.parse("degrade_snapshot:100")  # positional factor
+    assert plan.degrade_factor == 100.0
+    assert plan.degrade_nth == 1
+    plan = FaultPlan.parse("degrade_snapshot:factor=0.5,nth=2")
+    assert plan.degrade_factor == 0.5
+    assert plan.degrade_nth == 2
+    with pytest.raises(ValueError, match="needs a factor"):
+        FaultPlan.parse("degrade_snapshot:nth=2")
+    with pytest.raises(ValueError, match="degrade_snapshot"):
+        FaultPlan.parse("degrade_snapsho:100")  # typo lists valid kinds
+
+
+def test_degrade_fault_fires_on_nth_snapshot_publish(artifact_dir,
+                                                     tmp_path):
+    from fed_tgan_tpu.serve.demo import republish_demo_candidate
+
+    root = str(tmp_path / "artifact")
+    shutil.copytree(artifact_dir, root)
+    npz = os.path.join(root, "models", "synthesizer", "arrays.npz")
+
+    def first_2d_leaf():
+        with np.load(npz) as z:
+            for key in sorted(z.files):
+                arr = z[key]
+                if key.startswith("leaf_") and arr.ndim == 2 \
+                        and np.issubdtype(arr.dtype, np.floating):
+                    return key, arr
+        raise AssertionError("no 2-D float leaf in demo checkpoint")
+
+    key, before = first_2d_leaf()
+    install_plan(FaultPlan.parse("degrade_snapshot:factor=50,nth=2"))
+    try:
+        republish_demo_candidate(root)  # publish #1: not degraded
+        _, mid = first_2d_leaf()
+        np.testing.assert_array_equal(mid, before)
+        republish_demo_candidate(root)  # publish #2: degraded in place
+        key2, after = first_2d_leaf()
+        assert key2 == key
+        np.testing.assert_allclose(after, before * 50.0, rtol=1e-5)
+    finally:
+        install_plan(None)
+
+
+# --------------------------------------------------------------- e2e gate
+
+
+def test_degraded_snapshot_rejected_old_model_serves(artifact_dir,
+                                                     tmp_path):
+    """Acceptance: a degrade_snapshot-faulted generation is auto-rejected
+    — zero candidate bytes reach clients, the previous model keeps
+    serving, and the rejection is journaled with per-column forensics."""
+    root = str(tmp_path / "artifact")
+    shutil.copytree(artifact_dir, root)
+    jpath = str(tmp_path / "journal.jsonl")
+    journal = jr.RunJournal(jpath)
+    prev = jr.set_journal(journal)
+    svc = _canary_service(root)
+    try:
+        first_id = svc.registry.get().model_id
+        before = _get(f"{svc.url}/sample?rows=40&seed=7")
+        degrade_checkpoint(
+            os.path.join(root, "models", "synthesizer"), 100.0)
+        _force_poll(svc)
+        decision = svc.gate.last_decision
+        assert decision is not None and decision["promoted"] is False
+        assert decision["tripped"]
+        assert decision["per_column"]  # forensics: per-column deltas
+        assert any(abs(v["delta"]) > 0
+                   for v in decision["per_column"].values())
+        # the previous model serves untouched, bit-identical
+        assert svc.registry.get().model_id == first_id
+        assert _get(f"{svc.url}/sample?rows=40&seed=7") == before
+
+        # quarantine: the same rejected bytes are never re-scored, even
+        # when their stat signature moves again
+        scored = svc.gate.scored_total
+        os.utime(os.path.join(root, "models", "synthesizer", "arrays.npz"))
+        _force_poll(svc)
+        assert svc.gate.scored_total == scored
+        assert svc.gate.rejections == 1
+
+        metrics = _get(f"{svc.url}/metrics").decode()
+        assert 'fed_tgan_quality_rejections_total{tenant="demo"} 1' \
+            in metrics
+        assert 'fed_tgan_quality_jsd{tenant="demo"}' in metrics
+        health = json.loads(_get(f"{svc.url}/healthz"))
+        assert health["promotion"]["mode"] == "canary"
+        assert health["promotion"]["rejections"] == 1
+        assert health["promotion"]["quarantined"]
+        assert health["model_id"] == first_id
+    finally:
+        svc.shutdown(drain=False)
+        jr.set_journal(prev)
+        journal.close()
+    rejected = [e for e in jr.read_journal(jpath)
+                if e["type"] == "promotion_rejected"]
+    assert len(rejected) == 1
+    ev = rejected[0]
+    assert ev["tenant"] == "demo"
+    assert ev["model_id"] == first_id and ev["candidate"] != first_id
+    assert ev["tripped"] and ev["per_column"]
+    assert not any(e["type"] == "serve_reload"
+                   for e in jr.read_journal(jpath))
+
+
+def test_clean_candidate_promotes_bit_identical_to_immediate(artifact_dir,
+                                                             tmp_path):
+    """Acceptance: a clean new generation under --promote canary ends up
+    serving bytes bit-identical to what --promote immediate serves (both
+    equal the one-shot --sample-from CSV for the promoted artifact)."""
+    from fed_tgan_tpu import cli
+    from fed_tgan_tpu.serve.demo import republish_demo_candidate
+
+    root = str(tmp_path / "artifact")
+    shutil.copytree(artifact_dir, root)
+    jpath = str(tmp_path / "journal.jsonl")
+    journal = jr.RunJournal(jpath)
+    prev = jr.set_journal(journal)
+    svc = _canary_service(root)
+    try:
+        first_id = svc.registry.get().model_id
+        republish_demo_candidate(root)
+        _force_poll(svc)
+        decision = svc.gate.last_decision
+        assert decision is not None and decision["promoted"] is True
+        assert not decision["tripped"]
+        assert svc.registry.get().model_id != first_id
+        served = _get(f"{svc.url}/sample?rows=40&seed=7")
+
+        # what --promote immediate serves for the same on-disk artifact:
+        # the one-shot --sample-from file (test_serve proves immediate-
+        # mode served bytes match it)
+        out_dir = str(tmp_path / "oneshot")
+        rc = cli._run_sample_from(SimpleNamespace(
+            sample_from=root, sample_rows=40, seed=7,
+            out_dir=out_dir, quiet=True, allow_meta_mismatch=False))
+        assert rc == 0
+        with open(os.path.join(out_dir, "demo_synthesis_sampled.csv"),
+                  "rb") as f:
+            assert f.read() == served
+
+        metrics = _get(f"{svc.url}/metrics").decode()
+        assert 'fed_tgan_quality_promotions_total{tenant="demo"} 1' \
+            in metrics
+    finally:
+        svc.shutdown(drain=False)
+        jr.set_journal(prev)
+        journal.close()
+    events = list(jr.read_journal(jpath))
+    assert sum(e["type"] == "promotion_promoted" for e in events) == 1
+    assert sum(e["type"] == "serve_reload" for e in events) == 1
+
+
+def test_reload_failure_remembered_not_respammed(artifact_dir, tmp_path):
+    """Satellite regression: a generation that fails to load mid-reload
+    must advance the stat signature — logged and journaled ONCE, not on
+    every poll."""
+    from fed_tgan_tpu.serve.demo import republish_demo_candidate
+
+    root = str(tmp_path / "artifact")
+    shutil.copytree(artifact_dir, root)
+    jpath = str(tmp_path / "journal.jsonl")
+    journal = jr.RunJournal(jpath)
+    prev = jr.set_journal(journal)
+    try:
+        logs = []
+        reg = ModelRegistry(root, log=logs.append)
+        first_id = reg.get().model_id
+        republish_demo_candidate(root)  # moves the stat signature
+        # the encoder pickle is not in the signature, so this garbage
+        # survives the validity probe and explodes inside load_model
+        with open(os.path.join(root, "models",
+                               "label_encoders_demo.pickle"), "wb") as f:
+            f.write(b"not a pickle")
+        assert reg.maybe_reload() is False
+        assert reg.get().model_id == first_id
+        assert any("reload failed" in line for line in logs)
+        n_logs = len(logs)
+        assert reg.maybe_reload() is False  # remembered: no retry storm
+        assert len(logs) == n_logs
+    finally:
+        jr.set_journal(prev)
+        journal.close()
+    fails = [e for e in jr.read_journal(jpath)
+             if e["type"] == "serve_reload_failed"]
+    assert len(fails) == 1
+    assert fails[0]["model_id"] == first_id and fails[0]["error"]
+
+
+# ---------------------------------------------------------- fleet + store
+
+
+def test_fleet_canary_gate_per_tenant_status(artifact_dir):
+    from fed_tgan_tpu.serve.fleet import FleetRegistry, FleetService
+
+    fleet = FleetRegistry(promote="canary", log=_silent)
+    rt = fleet.load("t0", artifact_dir)
+    assert isinstance(rt.gate, CanaryGate)
+    assert rt.gate.status()["mode"] == "canary"
+    svc = FleetService(fleet, port=0, log=_silent)  # not started
+    status = svc.fleet_status()
+    assert status["tenants"][0]["promotion"]["mode"] == "canary"
+    # default immediate keeps the tenant runtime gate-free
+    plain = FleetRegistry(log=_silent).load("t0", artifact_dir)
+    assert plain.gate is None
+
+
+def test_quality_store_renders_only_after_decisions():
+    from fed_tgan_tpu.serve.metrics import QualityStore
+
+    store = QualityStore()
+    assert store.render_prometheus() == ""  # immediate mode: no new lines
+    store.record_scores("demo", 0.01, 0.02)
+    store.record_decision("demo", False)
+    text = store.render_prometheus()
+    assert 'fed_tgan_quality_jsd{tenant="demo"} 0.01' in text
+    assert 'fed_tgan_quality_wd{tenant="demo"} 0.02' in text
+    assert 'fed_tgan_quality_rejections_total{tenant="demo"} 1' in text
+
+
+# ------------------------------------------------------------- obs layer
+
+
+def test_slo_folds_promotion_events_and_trips_budget():
+    from fed_tgan_tpu.obs.slo import (
+        check_figures,
+        default_budgets_path,
+        journal_figures,
+        load_budgets,
+    )
+
+    figures = journal_figures([
+        {"type": "promotion_rejected", "avg_jsd": 0.6, "avg_wd": 0.4,
+         "jsd_delta": 0.5, "wd_delta": 0.01},
+        {"type": "promotion_promoted", "avg_jsd": 0.1, "avg_wd": 0.05,
+         "jsd_delta": 0.01, "wd_delta": 0.02},
+    ])
+    assert figures["quality/jsd_delta"] == 0.5   # worst observed wins
+    assert figures["quality/wd_delta"] == 0.02
+    rules = load_budgets(default_budgets_path())
+    regressions, _, matched, lines = check_figures(figures, rules)
+    assert matched >= 2
+    assert regressions >= 1  # jsd_delta 0.5 > the 0.15 budget
+    assert any("quality-jsd-delta" in line and "REGRESSION" in line
+               for line in lines)
+
+
+def test_report_gains_quality_section(tmp_path):
+    from fed_tgan_tpu.obs.report import render_text, summarize
+
+    jpath = str(tmp_path / "journal.jsonl")
+    journal = jr.RunJournal(jpath)
+    journal.emit("promotion_rejected", tenant="demo", candidate="beef",
+                 model_id="cafe", tripped=["quality-wd-delta"],
+                 per_column={"amount": {"kind": "wd", "candidate": 0.9,
+                                        "baseline": 0.1, "delta": 0.8}},
+                 avg_jsd=0.4, avg_wd=0.9)
+    journal.emit("promotion_promoted", tenant="demo", candidate="f00d",
+                 model_id="beef", tripped=[], per_column={},
+                 avg_jsd=0.05, avg_wd=0.04)
+    journal.emit("serve_reload_failed", model_id="cafe", error="torn")
+    journal.close()
+    summary = summarize(jpath)
+    q = summary["quality"]
+    assert q["promotions"] == 1 and q["rejections"] == 1
+    assert q["reload_failures"] == 1
+    assert q["tripped_budgets"] == ["quality-wd-delta"]
+    assert q["per_tenant"]["demo"]["avg_jsd_last"] == 0.05
+    text = render_text(summary)
+    assert "quality: 1 promotion(s), 1 rejection(s)" in text
+    assert "amount +0.8000" in text
