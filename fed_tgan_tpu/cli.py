@@ -89,6 +89,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "device count.  0 (default) or C = N = full "
                         "participation, bit-identical to the pre-cohort "
                         "program")
+    p.add_argument("--elastic-capacity", type=int, default=0,
+                   help="slot capacity for elastic membership: pack the "
+                        "population into this many trainer slots (rounded "
+                        "up to a power of two x device count) so clients "
+                        "admitted between rounds land in pre-padded slots "
+                        "with NO recompile until capacity overflows.  0 "
+                        "(default) = fixed population, bit-identical "
+                        "legacy shapes")
     p.add_argument("--aggregation", type=str, default="sync",
                    choices=["sync", "buffered"],
                    help="sync = every participating client's update lands "
@@ -821,6 +829,16 @@ def main(argv=None) -> int:
                       "pass --datapath/--client-data to evaluate a resumed run")
         if not args.quiet:
             print(f"resumed from {ckpt_src} at round {trainer.completed_epochs}")
+        from fed_tgan_tpu.testing.faults import active_plan
+
+        rplan = active_plan()
+        if rplan is not None and rplan.has_churn():
+            # raw client shards are not checkpointed, so the elastic layer
+            # cannot rebuild its population view on a resumed run
+            print("error: join:/leave:/drift: faults cannot drive a resumed "
+                  "run (raw client shards are not checkpointed); start a "
+                  "fresh run with --faults instead")
+            return 2
         with _observability(args):
             return _run_training(args, name, kwargs, trainer, init, frames,
                                  ckpt_dir)
@@ -898,10 +916,44 @@ def main(argv=None) -> int:
     else:
         trainer = FederatedTrainer(init, config=cfg, seed=args.seed,
                                    min_clients=args.min_clients or 1,
-                                   quarantine_strikes=args.quarantine_strikes)
+                                   quarantine_strikes=args.quarantine_strikes,
+                                   capacity=args.elastic_capacity)
+
+    elastic = newcomer_factory = None
+    from fed_tgan_tpu.testing.faults import active_plan
+
+    plan = active_plan()
+    if plan is not None and plan.has_churn():
+        if args.mode != "fedavg":
+            print("error: join:/leave:/drift: faults drive the elastic "
+                  "membership layer, which needs --mode fedavg")
+            return 2
+        from fed_tgan_tpu.federation.elastic import ElasticFederation
+        from fed_tgan_tpu.federation.streaming import OnboardingSession
+
+        elastic = ElasticFederation(trainer, OnboardingSession(init), clients)
+        # join: events need raw shards for the newcomers; the CLI has one
+        # input table, so newcomers arrive with deterministic bootstrap
+        # draws from it (round-seeded — a resumed run redraws identically)
+        pool_df = pd.concat(frames) if len(frames) > 1 else frames[0]
+        shard_rows = max(1, len(pool_df) // max(n_clients, 1))
+
+        def newcomer_factory(count, rnd):
+            drawn = pool_df.sample(
+                n=min(count * shard_rows, len(pool_df)),
+                random_state=args.seed * 100003 + rnd,
+            )
+            return [
+                TablePreprocessor(
+                    frame=drawn.iloc[i::count].reset_index(drop=True),
+                    name=name, selected_columns=columns, **kwargs)
+                for i in range(count)
+            ]
+
     with _observability(args):
         return _run_training(args, name, kwargs, trainer, init, frames,
-                             ckpt_dir)
+                             ckpt_dir, elastic=elastic,
+                             newcomer_factory=newcomer_factory)
 
 
 def _run_sample_from(args) -> int:
@@ -1013,7 +1065,8 @@ def _run_standalone(args, name, kwargs, frames, columns, cfg) -> int:
     return 0
 
 
-def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
+def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir,
+                  elastic=None, newcomer_factory=None) -> int:
     import pandas as pd
 
     from fed_tgan_tpu.train.snapshots import SnapshotWriter, result_path_fn
@@ -1169,7 +1222,22 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
     with mon_log:
         with snapshot:  # waits for in-flight snapshot CSVs, re-raises errors
             if remaining - prof_n:
-                if watchdog is not None:
+                if elastic is not None:
+                    # churn in the fault plan: the elastic layer owns the
+                    # fit loop (segments between churn/detection rounds;
+                    # it runs fit_with_watchdog itself when armed)
+                    elastic.watchdog = watchdog
+                    trainer = elastic.run(
+                        remaining - prof_n,
+                        fit_kwargs=dict(
+                            log_every=log_every,
+                            sample_hook=hook if use_hook else None,
+                            **fit_kwargs,
+                        ),
+                        ckpt_dir=ckpt_dir,
+                        newcomer_factory=newcomer_factory,
+                    )
+                elif watchdog is not None:
                     from fed_tgan_tpu.train.watchdog import fit_with_watchdog
 
                     # rollback replaces the trainer instance (reloaded from
@@ -1190,9 +1258,21 @@ def _run_training(args, name, kwargs, trainer, init, frames, ckpt_dir) -> int:
                 from fed_tgan_tpu.runtime.profiling import device_trace
 
                 with device_trace(args.profile_dir):
-                    trainer.fit(prof_n, log_every=log_every,
+                    if elastic is not None:
+                        trainer = elastic.run(
+                            prof_n,
+                            fit_kwargs=dict(
+                                log_every=log_every,
                                 sample_hook=hook if use_hook else None,
-                                **fit_kwargs)
+                                **fit_kwargs,
+                            ),
+                            ckpt_dir=ckpt_dir,
+                            newcomer_factory=newcomer_factory,
+                        )
+                    else:
+                        trainer.fit(prof_n, log_every=log_every,
+                                    sample_hook=hook if use_hook else None,
+                                    **fit_kwargs)
             last_epoch = trainer.completed_epochs - 1
             if args.sample_every == 0 and last_epoch >= 0:
                 snapshot(last_epoch, trainer)

@@ -1201,6 +1201,117 @@ def check_cost_ledger(timeout: int = 300) -> bool:
                  f"flops/bytes/peak; {slo_note}")
 
 
+def check_elastic_federation(timeout: int = 420) -> bool:
+    """Join / leave / drift mini-soak on a 2-client elastic trainer.
+
+    A subprocess trains a capacity-4 trainer through the full membership
+    lifecycle and asserts the three load-bearing properties:
+
+    - **zero-recompile join**: admitting a newcomer inside capacity
+      re-uploads data only — the armed compile counter sees no new
+      ``epoch_local`` trace;
+    - **departure renormalization**: after a leave, the survivor weights
+      renormalize to sum 1 with the departed slot at exactly 0;
+    - **drift detected and handled**: a schema-stable distribution shift
+      raises a ``drift_alarm`` in the next window and the refit +
+      weight recompute land in that same window."""
+    import json
+    import subprocess
+
+    code = (
+        "import json\n"
+        "import numpy as np\n"
+        "import pandas as pd\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from fed_tgan_tpu.analysis.sanitizers import sanitize\n"
+        "from fed_tgan_tpu.data.ingest import TablePreprocessor\n"
+        "from fed_tgan_tpu.federation.init import federated_initialize\n"
+        "from fed_tgan_tpu.federation.streaming import OnboardingSession\n"
+        "from fed_tgan_tpu.federation.elastic import (\n"
+        "    DriftConfig, ElasticFederation)\n"
+        "from fed_tgan_tpu.train.federated import FederatedTrainer\n"
+        "from fed_tgan_tpu.train.steps import TrainConfig\n"
+        "def mk(seed):\n"
+        "    r = np.random.default_rng(seed)\n"
+        "    return TablePreprocessor(frame=pd.DataFrame({\n"
+        "        'a': r.normal(size=120),\n"
+        "        'b': r.normal(2.0, 0.5, size=120),\n"
+        "        'c': r.choice(['x', 'y', 'z'], size=120)}),\n"
+        "        name='DoctorElastic', categorical_columns=['c'])\n"
+        "clients = [mk(0), mk(1)]\n"
+        "init = federated_initialize(clients, seed=0, backend='jax',\n"
+        "                            similarity='sketch')\n"
+        "cfg = TrainConfig(embedding_dim=8, gen_dims=(16,), dis_dims=(16,),\n"
+        "                  batch_size=40, pac=4)\n"
+        "out = {}\n"
+        "with sanitize(transfer_guard=False) as counter:\n"
+        "    tr = FederatedTrainer(init, config=cfg, seed=3, capacity=4)\n"
+        "    sess = OnboardingSession(init)\n"
+        "    ef = ElasticFederation(tr, sess, clients,\n"
+        "                           config=DriftConfig(detect_every=1))\n"
+        "    tr.fit(1)\n"
+        "    before = counter.count('epoch_local')\n"
+        "    ef.join([mk(2)])\n"
+        "    tr.fit(1)\n"
+        "    out['join_compiles'] = counter.count('epoch_local') - before\n"
+        "    out['joined_pop'] = int(ef.population)\n"
+        "ef.leave(1)\n"
+        "w = np.asarray(tr.weights, dtype=np.float64)\n"
+        "out['leave_renorm'] = bool(abs(w.sum() - 1.0) < 1e-5\n"
+        "                           and w[1] == 0.0)\n"
+        "ef.detect(1)  # post-membership window: WD suppressed, re-baselines\n"
+        "ef.apply_drift(0, shift=2.5, seed=7)\n"
+        "rec = ef.detect(2)\n"
+        "out['drift_alarmed'] = bool(0 in rec['drifted'])\n"
+        "out['recompute_lag'] = rec['recompute_lag_rounds']\n"
+        "tr.fit(1)\n"
+        "out['finished'] = int(tr.completed_epochs)\n"
+        "print(json.dumps(out))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "elastic-federation",
+                     f"timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return _line(False, "elastic-federation",
+                     " | ".join(tail) or "mini-soak failed")
+    try:
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        return _line(False, "elastic-federation",
+                     f"unparseable result: {exc!r}")
+    if res.get("join_compiles", 1):
+        return _line(False, "elastic-federation",
+                     f"a join inside capacity recompiled the round program "
+                     f"{res.get('join_compiles')} time(s) — the pow2 "
+                     f"population bucket is not holding")
+    if not res.get("leave_renorm"):
+        return _line(False, "elastic-federation",
+                     "survivor weights did not renormalize to sum 1 with "
+                     "the departed slot zeroed")
+    if not res.get("drift_alarmed"):
+        return _line(False, "elastic-federation",
+                     "a shift=2.5 scripted drift raised no drift_alarm in "
+                     "the next detection window")
+    if res.get("recompute_lag") != 0:
+        return _line(False, "elastic-federation",
+                     "similarity-weight recompute did not land in the "
+                     "window that detected the drift "
+                     f"(lag={res.get('recompute_lag')!r})")
+    return _line(True, "elastic-federation",
+                 f"{res.get('joined_pop')}-client population after a "
+                 "zero-recompile join; departure renormalized; drift "
+                 "alarmed and refit within one window")
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1259,6 +1370,7 @@ def main(argv=None) -> int:
         check_serving_fleet(),
         check_front_door(),
         check_quality_canary(),
+        check_elastic_federation(),
     ]
     bad = checks.count(False)
     print(f"{len(checks) - bad}/{len(checks)} checks passed")

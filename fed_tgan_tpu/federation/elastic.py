@@ -1,0 +1,433 @@
+"""Elastic federation: live membership churn and drift as handled events.
+
+The trainer's population was a cold-init constant: ``federated_initialize``
+priced everyone at once, the SPMD epoch program baked the slot count into
+its trace, and the only membership change was subtractive (PR 1 dropout).
+This module composes the pieces that already exist into a LIVE federation:
+
+- **joins** route through :class:`OnboardingSession.register_clients`
+  (frozen global layout, cache-aware local fits, softmax re-run over the
+  extended population) and land in the trainer via
+  ``FederatedTrainer.admit_clients`` — pow2 population/row/step buckets
+  mean a join inside capacity never recompiles the round program;
+- **departures** route through the PR 1 dropout path
+  (``drop_client`` -> survivor weight renormalization, steps zeroed,
+  no reshape);
+- **drift** is data, not corruption.  A scripted ``drift:`` fault swaps a
+  client's shard silently (same schema, shifted distribution); the
+  per-window detector re-scores residents' CURRENT shards against their
+  stored onboarding baselines through the PR 13 sketch scorer (content-hash
+  cache keeps unchanged shards free), refits the drifted clients' mode
+  normalization online (``rescore_client``), recomputes similarity weights
+  over the live population within the SAME window, and feeds sustained
+  drift into the existing quarantine-strike/eviction machinery.  Rollback
+  is never the remedy — restoring old model weights cannot undrift a
+  shard.
+
+Every transition is journaled (``client_joined`` / ``client_left`` /
+``drift_alarm`` / ``drift_window``) so ``obs report`` can narrate the
+membership history and ``obs slo`` can gate the drift trajectory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.federation.init import recompute_weights
+from fed_tgan_tpu.federation.streaming import OnboardingSession
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+from fed_tgan_tpu.obs.trace import span as _span
+
+log = logging.getLogger("fed_tgan_tpu.federation")
+
+
+@dataclasses.dataclass
+class DriftConfig:
+    """Detection-window policy for the elastic federation.
+
+    ``jsd_alarm`` is an ABSOLUTE rise threshold (raw JSD lives in [0, 1]
+    and is scored against the FROZEN global category counts, so the
+    baseline is pool-independent).  Raw sketch-WD is in data units AND
+    scored against the live resident pool — a pool that moves whenever a
+    member departs or refits — so a client's WD rise is measured in units
+    of the population's MEDIAN baseline WD per column (a near-zero
+    self-baseline on IID shards must not turn numerical noise into an
+    alarm, and a pool shift that moves everyone equally must not cascade).
+    ``detect_every=0`` disables the probe entirely.
+    """
+
+    detect_every: int = 5        # rounds between detection windows
+    jsd_alarm: float = 0.05      # absolute per-column raw-JSD rise
+    wd_alarm_rel: float = 3.0    # WD rise in population-median-WD units
+    refit: bool = True           # online refit + weight recompute on alarm
+
+
+def clone_with_frame(client: TablePreprocessor, frame) -> TablePreprocessor:
+    """Rebuild a preprocessor around a new RAW frame, same knobs.
+
+    ``__post_init__`` extends ``categorical_columns`` with date-derived
+    part-columns, so the constructor args must be recovered from the
+    post-init state: keep only user-named categoricals that exist in the
+    raw frame and aren't date keys (those re-extend on construction).
+    """
+    cats = [
+        c for c in client.categorical_columns
+        if c in client.frame.columns and c not in client.date_formats
+    ]
+    return TablePreprocessor(
+        frame=frame,
+        name=client.name,
+        categorical_columns=cats,
+        non_negative_columns=list(client.non_negative_columns),
+        date_formats=dict(client.date_formats),
+        target_column=client.target_column,
+        problem_type=client.problem_type,
+        selected_columns=client.selected_columns,
+    )
+
+
+class ElasticFederation:
+    """Membership + drift orchestrator over a live ``FederatedTrainer``.
+
+    Host-side state machine between fused device chunks: the trainer owns
+    the device arrays, the :class:`OnboardingSession` owns the similarity
+    state, and this class keeps them in lockstep while clients join,
+    leave, and drift.  ``self.clients[i]`` is the CURRENT raw shard of
+    global client ``i`` (drift swaps it); indices align with the
+    trainer/init population because joins only append.
+    """
+
+    def __init__(
+        self,
+        trainer,
+        session: OnboardingSession,
+        clients: Sequence[TablePreprocessor],
+        watchdog=None,
+        config: Optional[DriftConfig] = None,
+    ):
+        if len(clients) != trainer.n_clients:
+            raise ValueError(
+                f"{len(clients)} client shards for a {trainer.n_clients}-"
+                f"client trainer; pass the same population both got"
+            )
+        self.trainer = trainer
+        self.session = session
+        self.clients: list[TablePreprocessor] = list(clients)
+        self.watchdog = watchdog
+        self.cfg = config or DriftConfig()
+        self.windows: list[dict] = []   # drift trajectory (one row/window)
+        self._applied_events: set[tuple] = set()
+        # per-client (jsd_row, wd_row) from the LAST window (seeded from
+        # onboarding); refreshed every window so drift is window-over-
+        # window, not cumulative-vs-cold-init — the refit absorbs a shift
+        # and the next window is quiet again
+        self._baseline: dict[int, tuple] = {}
+        # membership changed since the last window: the pooled WD
+        # reference moved for EVERY survivor, so the (pool-relative) WD
+        # criterion is meaningless until baselines re-anchor — the next
+        # window alarms on the pool-independent JSD signal alone
+        self._pool_changed = False
+        # keep the trainer's init pointed at the session's latest snapshot
+        self.trainer.init = self.session.init
+
+    # ------------------------------------------------------------- membership
+
+    @property
+    def population(self) -> int:
+        return self.trainer.n_clients
+
+    def _alive_mask(self) -> np.ndarray:
+        alive = np.ones(self.population, dtype=bool)
+        if self.trainer.dropped_clients:
+            alive[sorted(self.trainer.dropped_clients)] = False
+        return alive
+
+    def join(self, newcomers: Sequence[TablePreprocessor],
+             reason: str = "join") -> None:
+        """Admit newcomers between rounds: similarity onboarding through
+        the streaming session, then population landing in the trainer
+        (``client_joined`` events are emitted there, with the repack
+        verdict)."""
+        new_init = self.session.register_clients(newcomers)
+        self.trainer.admit_clients(new_init, reason=reason)
+        self.clients.extend(newcomers)
+        self._pool_changed = True
+
+    def leave(self, idx: int, reason: str = "scripted departure") -> None:
+        """Departure through the PR 1 dropout path; survivors renormalize."""
+        _emit_event(
+            "client_left", client=int(idx),
+            round=int(self.trainer.completed_epochs), reason=reason,
+            survivors=self.population - len(self.trainer.dropped_clients) - 1,
+        )
+        self.trainer.drop_client(idx, reason)
+        self._pool_changed = True
+
+    def apply_drift(self, idx: int, shift: float, seed: int = 0) -> None:
+        """SILENTLY swap client ``idx``'s shard for a distribution-shifted
+        one (schema-stable, deterministic).  No similarity state moves
+        here — the point is that the next detection window must CATCH it:
+        the drifted matrix is encoded with the frozen global encoders and
+        transformed with the client's EXISTING (pre-drift) transformer,
+        exactly the staleness the online refit later repairs.
+        """
+        from fed_tgan_tpu.testing import faults as _faults
+
+        if not 0 <= idx < self.population:
+            raise IndexError(f"client index {idx} out of range")
+        cur = self.clients[idx]
+        drifted = clone_with_frame(
+            cur, _faults.drift_frame(cur.frame, shift=shift, seed=seed)
+        )
+        matrix, _, _ = drifted.encode(self.session.init.encoders)
+        encoded = self.session.init.transformers[idx].transform(
+            matrix, rng=np.random.default_rng(seed + idx)
+        )
+        self.trainer.update_client_shard(idx, encoded)
+        self.clients[idx] = drifted
+        log.info("drift applied to client %d (shift=%s, seed=%d); "
+                 "detector owns the discovery", idx, shift, seed)
+
+    # ------------------------------------------------------------- detection
+
+    def detect(self, round_idx: Optional[int] = None) -> dict:
+        """One detection window: re-score every live resident's CURRENT
+        shard against its stored onboarding baseline; alarm, refit, and
+        recompute weights for the drifted; charge sustained drift into the
+        quarantine strike machinery.  Returns the window record (also
+        appended to ``self.windows`` — the drift trajectory artifact).
+        """
+        if round_idx is None:
+            round_idx = int(self.trainer.completed_epochs)
+        alive = self._alive_mask()
+        live = np.nonzero(alive)[0]
+        if live.size == 0:
+            raise RuntimeError("no live clients to score")
+        ob = self.session.init.onboarding
+        with _span("elastic.detect", round=round_idx, clients=len(live)):
+            jsd_rows, wd_rows = self.session.score_clients(
+                [self.clients[i] for i in live], alive=alive
+            )
+            ob_jsd = np.asarray(ob["jsd_raw"], dtype=np.float64)
+            ob_wd = np.asarray(ob["wd_raw"], dtype=np.float64)
+            base_jsd = np.stack([
+                self._baseline.get(int(c), (ob_jsd[c], ob_wd[c]))[0]
+                for c in live
+            ]) if len(live) else ob_jsd[:0]
+            base_wd = np.stack([
+                self._baseline.get(int(c), (ob_jsd[c], ob_wd[c]))[1]
+                for c in live
+            ]) if len(live) else ob_wd[:0]
+            jsd_rise = (
+                (jsd_rows - base_jsd).max(axis=1)
+                if jsd_rows.shape[1] else np.zeros(len(live))
+            )
+            # per-column population scale: a pool shift that moves every
+            # client's WD equally must not read as everyone drifting
+            scale = (
+                np.maximum(np.median(np.abs(base_wd), axis=0), 1e-6)
+                if wd_rows.shape[1] else None
+            )
+            wd_rise = (
+                ((wd_rows - base_wd) / scale).max(axis=1)
+                if wd_rows.shape[1] else np.zeros(len(live))
+            )
+            # a join/leave since the last window moved the pooled WD
+            # reference under every survivor at once; only the absolute
+            # JSD criterion is trustworthy until baselines re-anchor
+            # (they do below, unconditionally — one window of WD blind-
+            # ness, never a false-alarm cascade)
+            wd_suppressed = self._pool_changed
+            hit = jsd_rise > self.cfg.jsd_alarm
+            if not wd_suppressed:
+                hit = hit | (wd_rise > self.cfg.wd_alarm_rel)
+            self._pool_changed = False
+            for k, c in enumerate(live):
+                self._baseline[int(c)] = (jsd_rows[k], wd_rows[k])
+            drifted = [int(live[k]) for k in np.nonzero(hit)[0]]
+            for k in np.nonzero(hit)[0]:
+                _emit_event(
+                    "drift_alarm", client=int(live[k]), round=round_idx,
+                    jsd_rise=round(float(jsd_rise[k]), 6),
+                    wd_rise=round(float(wd_rise[k]), 6),
+                )
+            if drifted and self.cfg.refit:
+                for c in drifted:
+                    # online refit: local GMMs, mode-normalized matrix,
+                    # raw score rows REPLACED at index c
+                    new_init = self.session.rescore_client(
+                        c, self.clients[c]
+                    )
+                    self.trainer.update_client_shard(
+                        c, new_init.client_matrices[c]
+                    )
+                ob = self.session.init.onboarding
+                weights = recompute_weights(
+                    ob["jsd_raw"], ob["wd_raw"],
+                    self.session.init.rows_per_client,
+                    alive=alive, weighted=ob["params"]["weighted"],
+                )
+                self.trainer.update_weights(weights)
+                self.trainer.init = self.session.init
+                # the refit MOVED the pooled WD reference (the repaired
+                # mixtures re-enter the pool), so every survivor's
+                # baseline re-anchors against the post-refit pool: next
+                # window's rises measure future drift, not this window's
+                # repair — and unlike a blanket one-window WD blackout,
+                # a re-drifted shard still reads as a fresh WD rise
+                jsd2, wd2 = self.session.score_clients(
+                    [self.clients[i] for i in live], alive=alive
+                )
+                for k, c in enumerate(live):
+                    self._baseline[int(c)] = (jsd2[k], wd2[k])
+            sustained = (
+                self.watchdog.observe_drift(round_idx, drifted)
+                if self.watchdog is not None else []
+            )
+            evicted = []
+            for c in sustained:
+                if c in self.trainer.dropped_clients:
+                    continue
+                self.trainer._strikes[c] += 1
+                strikes = int(self.trainer._strikes[c])
+                _emit_event(
+                    "quarantine", client=int(c), rounds=1,
+                    first=round_idx, last=round_idx,
+                    strikes=strikes, test="drift",
+                )
+                if strikes >= self.trainer.quarantine_strikes:
+                    self.leave(
+                        c,
+                        f"sustained drift across "
+                        f"{self.watchdog.cfg.drift_patience}+ windows "
+                        f"(strike limit {self.trainer.quarantine_strikes})",
+                    )
+                    evicted.append(int(c))
+        record = {
+            "round": round_idx,
+            "population": int(self.population),
+            "live": int(alive.sum() - len(evicted)),
+            "scored": int(live.size),
+            "alarms": len(drifted),
+            "drifted": drifted,
+            "sustained": [int(c) for c in sustained],
+            "evicted": evicted,
+            "max_jsd_rise": round(float(jsd_rise.max(initial=0.0)), 6),
+            "max_wd_rise": round(float(wd_rise.max(initial=0.0)), 6),
+            # refit + weight recompute happen inside this same window,
+            # so detection-to-recompute lag is 0 rounds by construction;
+            # recorded (not assumed) so the SLO gate measures, not trusts
+            "recompute_lag_rounds": 0 if (drifted and self.cfg.refit)
+            else None,
+            # membership changed since the last window: WD criterion sat
+            # out (pool-relative; the move was the pool's, not a shard's)
+            "wd_suppressed": True if wd_suppressed else None,
+        }
+        self.windows.append(record)
+        _emit_event("drift_window", **{
+            k: v for k, v in record.items() if v is not None
+        })
+        return record
+
+    # -------------------------------------------------------------- training
+
+    def run(
+        self,
+        epochs: int,
+        plan=None,
+        fit_kwargs: Optional[dict] = None,
+        ckpt_dir: Optional[str] = None,
+        newcomer_factory: Optional[Callable[[int, int], list]] = None,
+        on_rollback: Optional[Callable] = None,
+    ):
+        """Train ``epochs`` rounds, applying scripted churn between fused
+        chunks and running the drift probe every ``detect_every`` rounds.
+
+        ``plan`` defaults to the ambient :func:`testing.faults.active_plan`;
+        its ``join:``/``leave:``/``drift:`` events fire at their scripted
+        round boundaries.  ``newcomer_factory(count, round)`` must supply
+        raw shards for ``join:`` events.  With a watchdog AND ``ckpt_dir``,
+        each segment trains under :func:`fit_with_watchdog` (rollback
+        re-syncs the session to the restored trainer); churn events are
+        applied exactly once even when a rollback re-traverses their round.
+        Checkpointing rides the usual ``fit_kwargs["sample_hook"]`` /
+        ``hook_epochs`` channel — this loop adds no save cadence of its own.
+        """
+        from fed_tgan_tpu.testing.faults import active_plan
+
+        if plan is None:
+            plan = active_plan()
+        fit_kwargs = dict(fit_kwargs or {})
+        start = int(self.trainer.completed_epochs)
+        target = start + int(epochs)
+        de = int(self.cfg.detect_every)
+
+        while self.trainer.completed_epochs < target:
+            e = int(self.trainer.completed_epochs)
+            if plan is not None and plan.has_churn():
+                for ev in plan.churn_events(e):
+                    key = (e,) + tuple(ev)
+                    if key in self._applied_events:
+                        continue   # rollback re-traversal: applied already
+                    self._applied_events.add(key)
+                    if ev[0] == "join":
+                        if newcomer_factory is None:
+                            raise ValueError(
+                                f"fault plan schedules a join at round "
+                                f"{e + 1} but no newcomer_factory was given"
+                            )
+                        self.join(newcomer_factory(int(ev[1]), e))
+                    elif ev[0] == "leave":
+                        self.leave(
+                            int(ev[1]),
+                            f"scripted departure at round {e + 1}",
+                        )
+                    else:  # drift
+                        self.apply_drift(
+                            int(ev[1]), float(ev[2]), seed=e,
+                        )
+            if de and e > start and (e - start) % de == 0 and \
+                    ("window", e) not in self._applied_events:
+                self._applied_events.add(("window", e))
+                self.detect(e)
+
+            # segment ends at the next churn round, the next detection
+            # window, or the target — whichever comes first
+            edges = [target]
+            if plan is not None and plan.has_churn():
+                nxt = plan.next_churn_round(e + 1)
+                if nxt is not None:
+                    edges.append(nxt)
+            if de:
+                edges.append(e + de - (e - start) % de)
+            stop = max(e + 1, min(edges))
+            seg = stop - e
+            if self.watchdog is not None and ckpt_dir:
+                from fed_tgan_tpu.train.watchdog import fit_with_watchdog
+
+                def _rb(tr):
+                    self._on_rollback(tr)
+                    if on_rollback is not None:
+                        on_rollback(tr)
+
+                self.trainer = fit_with_watchdog(
+                    self.trainer, seg, self.watchdog, ckpt_dir,
+                    fit_kwargs=dict(fit_kwargs),
+                    on_rollback=_rb,
+                )
+            else:
+                self.trainer.fit(seg, **fit_kwargs)
+        return self.trainer
+
+    def _on_rollback(self, trainer) -> None:
+        """Re-sync host-side state to the restored trainer: the session's
+        similarity snapshot reverts with the checkpointed init (baselines
+        included); raw shards stay current — if drift landed before the
+        checkpoint, the next window simply re-detects and re-repairs."""
+        self.trainer = trainer
+        self.session.init = trainer.init

@@ -255,6 +255,46 @@ def aggregation_weights(
     return e / e.sum()
 
 
+def recompute_weights(
+    jsd_raw: np.ndarray,
+    wd_raw: np.ndarray,
+    rows_per_client: Sequence[int],
+    alive: Optional[np.ndarray] = None,
+    weighted: bool = True,
+) -> np.ndarray:
+    """Similarity weights from RAW per-column distances, restricted to the
+    live population.
+
+    The drift detector re-scores clients per window (fresh ``wd_raw`` rows
+    from the sketch scorer, fresh ``jsd_raw`` from category counts) and
+    needs the paper's full pipeline — per-column normalization over the
+    CURRENT population, then the softmax combine — rather than the frozen
+    init-time weights.  ``alive=None`` means everyone; a departed client
+    keeps its raw score rows (the matrices stay packed) but exits both the
+    normalization and the final renormalization, so survivors see exactly
+    the weights a from-scratch init over the survivor set would produce.
+    ``weighted=False`` (uniform FedAvg runs) skips similarity and splits
+    mass evenly over the live clients.
+    """
+    n = len(rows_per_client)
+    if alive is None:
+        alive = np.ones(n, dtype=bool)
+    alive = np.asarray(alive, dtype=bool)
+    if not weighted:
+        return renormalize_weights(np.full(n, 1.0 / n), alive)
+    idx = np.nonzero(alive)[0]
+    if idx.size == 0:
+        raise ValueError("no surviving clients: all aggregation weight lost")
+    live_jsd = _normalize_per_column(
+        np.asarray(jsd_raw, dtype=np.float64)[idx], idx.size)
+    live_wd = _normalize_per_column(
+        np.asarray(wd_raw, dtype=np.float64)[idx], idx.size)
+    live_rows = [rows_per_client[i] for i in idx]
+    w = np.zeros(n, dtype=np.float32)
+    w[idx] = aggregation_weights(live_jsd, live_wd, live_rows)
+    return w
+
+
 def renormalize_weights(weights: np.ndarray, alive: np.ndarray) -> np.ndarray:
     """Restrict aggregation weights to the surviving clients and rescale to
     sum 1 — the paper's similarity weighting over live ranks only.  ``alive``

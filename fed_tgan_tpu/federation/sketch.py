@@ -69,6 +69,27 @@ def stack_client_gmms(
     return means, stds, weights
 
 
+def live_omega(
+    rows_per_client: Sequence[int],
+    alive: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Rows-proportional pool weights restricted to the LIVE residents.
+
+    The elastic-federation drift probe scores clients against the resident
+    mixture pool every window; once members depart, their fitted mixtures
+    remain in the stacks (indices stay stable) but must stop shaping the
+    pooled reference CDF — a mask here is cheaper and steadier than
+    re-stacking the survivor subset.  ``alive=None`` keeps everyone.
+    """
+    omega = np.asarray(rows_per_client, dtype=np.float64)
+    if alive is not None:
+        omega = omega * np.asarray(alive, dtype=bool)
+    total = omega.sum()
+    if total <= 0.0:
+        raise ValueError("no live residents: pooled reference is empty")
+    return omega / total
+
+
 def _wd_impl(means, stds, weights, omega, grid):
     """(N, C, K) mixtures + (N,) pool weights + (C, G) grid -> (N, C) W1."""
     import jax.numpy as jnp

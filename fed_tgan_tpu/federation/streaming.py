@@ -165,6 +165,117 @@ class OnboardingSession:
                     clients=n_all, rows=int(np.sum(rows)))
         return self.init
 
+    def score_clients(
+        self, shards: Sequence[TablePreprocessor],
+        alive: Optional[np.ndarray] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Score shards against the frozen references WITHOUT mutation.
+
+        Runs the full admission screen (schema, vocabulary, finiteness —
+        ``on_invalid="raise"``), the cache-aware local GMM fits, and the
+        raw JSD / sketch-WD scoring, but touches no session state.  This
+        is the per-window drift probe: re-score a resident's CURRENT
+        shard and compare the rows against the stored baseline in
+        ``init.onboarding["jsd_raw"]/["wd_raw"]``.  Unchanged shards are
+        content-hash cache hits, so a window's cost is dominated by the
+        clients that actually drifted.
+
+        Returns ``(jsd_raw_rows, wd_raw_rows)``, one row per shard.
+        """
+        ob = self.init.onboarding
+        params = ob["params"]
+        cont_idx, cat_idx = ob["cont_idx"], ob["cat_idx"]
+        admitted, matrices, metas = self._screen(shards, cat_idx, "raise")
+        gmms_list = self._fit_locals(admitted, matrices, metas, cont_idx,
+                                     params["seed"], params["backend"])
+        jsd_rows = self._jsd_raw(metas, cat_idx)
+        wd_rows, _ = self._wd_raw(gmms_list, cont_idx, alive=alive)
+        return jsd_rows, wd_rows
+
+    def rescore_client(
+        self, idx: int, shard: TablePreprocessor
+    ) -> FederatedInit:
+        """Online refit for a DRIFTED resident; returns the new snapshot.
+
+        The frozen global layout survives (vocabulary, global GMMs,
+        ``output_dim`` — compiled-program shapes never move); what refits
+        is everything local to client ``idx``: its encoded matrix is
+        re-transformed through a fresh frozen-layout ``ModeNormalizer``
+        (each drifted row re-normalized by its newly-assigned mode — the
+        online refit of mode-specific normalization), its local GMMs are
+        re-fitted for similarity scoring, its rows in the raw score
+        matrices and the resident mixture stacks are REPLACED (not
+        appended), and the per-column normalization + softmax re-run over
+        the population — so every client's weight reflects the drifted
+        distribution within the same window that detected it.
+        """
+        init, ob = self.init, self.init.onboarding
+        if not 0 <= idx < len(init.rows_per_client):
+            raise IndexError(f"client index {idx} out of range")
+        params = ob["params"]
+        seed, backend = params["seed"], params["backend"]
+        cont_idx, cat_idx = ob["cont_idx"], ob["cat_idx"]
+        t0 = time.perf_counter()
+        with _span("init.rescore_client", client=idx):
+            admitted, matrices, metas = self._screen([shard], cat_idx,
+                                                     "raise")
+            gmms_list = self._fit_locals(admitted, matrices, metas,
+                                         cont_idx, seed, backend)
+            jsd_row = self._jsd_raw(metas, cat_idx)
+            wd_row, stacks_new = self._wd_raw(gmms_list, cont_idx)
+
+            jsd_raw = np.array(ob["jsd_raw"], copy=True)
+            wd_raw = np.array(ob["wd_raw"], copy=True)
+            jsd_raw[idx] = jsd_row[0]
+            wd_raw[idx] = wd_row[0]
+            rows = list(init.rows_per_client)
+            rows[idx] = len(matrices[0])
+            n_all = len(rows)
+            jsd = _normalize_per_column(jsd_raw, n_all)
+            wd = _normalize_per_column(wd_raw, n_all)
+            weights = (
+                aggregation_weights(jsd, wd, rows)
+                if params["weighted"] else np.full(n_all, 1.0 / n_all)
+            )
+
+            transformers = list(init.transformers)
+            client_matrices = list(init.client_matrices)
+            tf = ModeNormalizer(
+                backend=backend, seed=seed
+            ).refit_with_global(init.global_meta, init.encoders,
+                                transformers[0].column_gmms)
+            transformers[idx] = tf
+            if client_matrices:
+                client_matrices[idx] = tf.transform(
+                    matrices[0], rng=np.random.default_rng(seed + idx)
+                )
+
+            mix = [np.array(ob[k], copy=True)
+                   for k in ("mix_means", "mix_stds", "mix_weights")]
+            for stack, new in zip(mix, stacks_new):
+                stack[idx] = new[0]
+            onboarding = dict(
+                ob, jsd_raw=jsd_raw, wd_raw=wd_raw,
+                mix_means=mix[0], mix_stds=mix[1], mix_weights=mix[2],
+            )
+            self.init = FederatedInit(
+                global_meta=init.global_meta,
+                encoders=init.encoders,
+                transformers=transformers,
+                client_matrices=client_matrices,
+                weights=weights,
+                jsd=jsd,
+                wd=wd,
+                rows_per_client=rows,
+                jsd_raw=jsd_raw,
+                wd_raw=wd_raw,
+                onboarding=onboarding,
+            )
+        _emit_event("init_phase", phase="rescore_client",
+                    seconds=round(time.perf_counter() - t0, 6),
+                    clients=n_all, rows=int(np.sum(rows)))
+        return self.init
+
     # ------------------------------------------------------------ internals
 
     def _reject(self, why: str, on_invalid: str) -> bool:
@@ -296,10 +407,12 @@ class OnboardingSession:
                 out[r, cursor] = _sdistance.jensenshannon(counts, vec)
         return np.nan_to_num(out, nan=0.0)
 
-    def _wd_raw(self, gmms_list, cont_idx):
+    def _wd_raw(self, gmms_list, cont_idx, alive=None):
         """Raw WD of each newcomer against the FROZEN resident pool: one
         sketch program where residents carry the pool weights and every
-        newcomer carries omega 0 (scored, but not reshaping the pool)."""
+        newcomer carries omega 0 (scored, but not reshaping the pool).
+        ``alive`` (elastic churn) masks departed residents out of the
+        pooled reference while keeping the stacks index-stable."""
         from fed_tgan_tpu.federation import sketch as _sketch
 
         ob = self.init.onboarding
@@ -315,9 +428,9 @@ class OnboardingSession:
         stds = np.concatenate([ob["mix_stds"], stacks_new[1]])
         weights = np.concatenate([ob["mix_weights"], stacks_new[2]])
         n_res = ob["mix_means"].shape[0]
-        rows_res = np.asarray(self.init.rows_per_client, dtype=np.float64)
         omega = np.concatenate(
-            [rows_res / rows_res.sum(), np.zeros(len(gmms_list))]
+            [_sketch.live_omega(self.init.rows_per_client, alive),
+             np.zeros(len(gmms_list))]
         )
         wd_all = _sketch.wd_sketch(
             None, None, cont_idx, omega=omega,

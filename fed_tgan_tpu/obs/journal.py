@@ -22,7 +22,21 @@ cohort                    per-round partial-participation summary (population,
                           sampled client ids, staleness histogram, buffered
                           update counts)
 quarantine                in-round update screen quarantined a client
+                          (``test="drift"`` when charged by the elastic
+                          drift detector instead of the in-graph gate)
 client_dropped            dead/evicted client removed from federation
+client_joined             elastic federation admitted a newcomer between
+                          rounds (round, population, capacity, weight,
+                          rows, whether admission forced a bucket repack)
+client_left               elastic federation departure (scripted or
+                          drift-evicted) before the dropout-path
+                          ``client_dropped`` that executes it
+drift_alarm               per-window drift probe flagged a client (raw
+                          JSD/WD rises vs its onboarding baseline)
+drift_window              one detection-window summary: population, scored
+                          clients, alarm count, sustained/evicted lists,
+                          max score rises, refit lag -- the drift
+                          trajectory artifact row
 watchdog_alarm            training-health watchdog tripped
 watchdog_rollback         watchdog restored params from a checkpoint
 checkpoint                crash-safe checkpoint published
@@ -97,6 +111,7 @@ EVENT_TYPES = frozenset({
     "run_start", "run_end",
     "round", "aggregate", "cohort",
     "quarantine", "client_dropped",
+    "client_joined", "client_left", "drift_alarm", "drift_window",
     "watchdog_alarm", "watchdog_rollback",
     "checkpoint", "checkpoint_restore",
     "transport_reconnect", "transport_drop", "heartbeat_lapse",

@@ -20,12 +20,18 @@ __all__ = ["summarize", "summarize_many", "render_text"]
 
 def _clients_section(contribs: List[dict], quarantines: List[dict],
                      alarms: List[dict], rollbacks: List[dict],
-                     drops: List[dict]) -> dict:
+                     drops: List[dict],
+                     joins: Sequence[dict] = (),
+                     lefts: Sequence[dict] = (),
+                     drift_alarms: Sequence[dict] = ()) -> dict:
     """Fold ``client_contribution`` events into one per-round client table.
 
     Order-independent across merged rank journals: rows are keyed by
     (round, client) and folded in sorted order, so merging ``[a, b]``
-    and ``[b, a]`` produces identical output.
+    and ``[b, a]`` produces identical output.  Elastic-membership events
+    (``client_joined`` / ``client_left`` / ``drift_alarm``) annotate the
+    same per-client entries so one section narrates who joined, left,
+    drifted, or got quarantined.
     """
     rows = []
     for ev in contribs:
@@ -73,6 +79,23 @@ def _clients_section(contribs: List[dict], quarantines: List[dict],
             d["strikes"] = max(d["strikes"], s)
     dropped_by = {int(e["client"]): str(e.get("reason", "")) for e in drops
                   if e.get("client") is not None}
+    joined_by = {int(e["client"]): e for e in joins
+                 if e.get("client") is not None}
+    left_by = {int(e["client"]): str(e.get("reason", "")) for e in lefts
+               if e.get("client") is not None}
+    drift_count: Dict[int, int] = {}
+    for e in drift_alarms:
+        if e.get("client") is not None:
+            drift_count[int(e["client"])] = \
+                drift_count.get(int(e["client"]), 0) + 1
+    # membership events may name clients the contribution ledger never
+    # saw (a newcomer that joined after the last ledger pull): give them
+    # a row anyway so the narration is complete
+    for c in set(joined_by) | set(left_by) | set(drift_count):
+        track.setdefault(c, {
+            "rounds": 0, "first_round": None, "weight_first": None,
+            "quarantined_rounds": 0, "strikes": 0,
+        })
     for c in sorted(track):
         d = track[c]
         wf, wl = d.get("weight_first"), d.get("weight_last")
@@ -80,6 +103,15 @@ def _clients_section(contribs: List[dict], quarantines: List[dict],
                              if wf is not None and wl is not None else None)
         if c in dropped_by:
             d["dropped"] = dropped_by[c] or True
+        if c in joined_by:
+            je = joined_by[c]
+            d["joined_round"] = je.get("round")
+            if je.get("repacked"):
+                d["join_repacked"] = True
+        if c in left_by:
+            d["left"] = left_by[c] or True
+        if c in drift_count:
+            d["drift_alarms"] = drift_count[c]
         per_client[str(c)] = d
 
     movers = sorted(
@@ -119,13 +151,21 @@ def _clients_section(contribs: List[dict], quarantines: List[dict],
         forensics.append(entry)
     forensics.sort(key=lambda f: (f.get("first") or 0, f["client"]))
 
-    return {
+    out = {
         "tracked": len(per_client),
         "rounds": len(table),
         "per_client": per_client,
         "top_movers": movers[:5],
         "forensics": forensics,
     }
+    if joins or lefts or drift_alarms:
+        out["membership"] = {
+            "joins": len(list(joins)),
+            "leaves": len(list(lefts)),
+            "drift_alarms": len(list(drift_alarms)),
+            "join_repacks": sum(1 for e in joins if e.get("repacked")),
+        }
+    return out
 
 
 def _similarity_section(sims: List[dict]) -> dict:
@@ -283,9 +323,33 @@ def summarize_many(paths: Sequence[str], on_skip=None) -> dict:
         }
 
     contribs = [e for e in events if e.get("type") == "client_contribution"]
-    if contribs:
+    joins = [e for e in events if e.get("type") == "client_joined"]
+    lefts = [e for e in events if e.get("type") == "client_left"]
+    drift_als = [e for e in events if e.get("type") == "drift_alarm"]
+    if contribs or joins or lefts or drift_als:
         out["clients"] = _clients_section(contribs, quarantines,
-                                          alarms, rollbacks, drops)
+                                          alarms, rollbacks, drops,
+                                          joins=joins, lefts=lefts,
+                                          drift_alarms=drift_als)
+
+    drift_ws = [e for e in events if e.get("type") == "drift_window"]
+    if drift_ws:
+        rises_j = [float(e["max_jsd_rise"]) for e in drift_ws
+                   if isinstance(e.get("max_jsd_rise"), (int, float))]
+        rises_w = [float(e["max_wd_rise"]) for e in drift_ws
+                   if isinstance(e.get("max_wd_rise"), (int, float))]
+        last = drift_ws[-1]
+        out["drift"] = {
+            "windows": len(drift_ws),
+            "alarms_total": sum(int(e.get("alarms", 0) or 0)
+                                for e in drift_ws),
+            "evicted": sorted({int(c) for e in drift_ws
+                               for c in (e.get("evicted") or [])}),
+            "max_jsd_rise": round(max(rises_j), 6) if rises_j else None,
+            "max_wd_rise": round(max(rises_w), 6) if rises_w else None,
+            "final_live": last.get("live"),
+            "final_population": last.get("population"),
+        }
 
     sims = [e for e in events if e.get("type") == "similarity"]
     if sims:
@@ -510,17 +574,33 @@ def render_text(summary: dict) -> str:
                      f"event(s), dropped clients {rb['clients_dropped']}")
     cl = summary.get("clients")
     if cl:
+        mem = cl.get("membership")
+        churn = ""
+        if mem:
+            churn = (f"; membership: {mem['joins']} join(s) "
+                     f"({mem['join_repacks']} repack(s)), "
+                     f"{mem['leaves']} departure(s), "
+                     f"{mem['drift_alarms']} drift alarm(s)")
         lines.append(f"  clients: {cl['tracked']} tracked over "
-                     f"{cl['rounds']} round(s)")
+                     f"{cl['rounds']} round(s){churn}")
         for c, d in cl.get("per_client", {}).items():
             wf, wl = d.get("weight_first"), d.get("weight_last")
             traj = (f"weight {wf:.4f}->{wl:.4f}"
                     if wf is not None and wl is not None else "weight n/a")
             extra = ""
+            if d.get("joined_round") is not None:
+                extra += (f", joined@{d['joined_round']}"
+                          + (" (repack)" if d.get("join_repacked") else ""))
+            if d.get("drift_alarms"):
+                extra += f", {d['drift_alarms']} drift alarm(s)"
             if d.get("quarantined_rounds"):
                 extra += (f", {d['quarantined_rounds']} quarantined "
                           f"round(s), {d['strikes']} strike(s)")
-            if d.get("dropped"):
+            if d.get("left"):
+                left = d["left"]
+                extra += (f" [LEFT ({left})]" if isinstance(left, str)
+                          else " [LEFT]")
+            elif d.get("dropped"):
                 extra += " [DROPPED]"
             lines.append(f"    client {c}: {traj}, "
                          f"{d['rounds']} round(s){extra}")
@@ -538,6 +618,16 @@ def render_text(summary: dict) -> str:
                 f"    forensics: client {f['client']} quarantined rounds "
                 f"{f.get('first')}..{f.get('last')} "
                 f"(test={f.get('test')}, strikes={f.get('strikes')}){tail}")
+    dr = summary.get("drift")
+    if dr:
+        lines.append(f"  drift: {dr['alarms_total']} alarm(s) over "
+                     f"{dr['windows']} window(s), max jsd rise "
+                     f"{dr.get('max_jsd_rise')}, max wd rise "
+                     f"{dr.get('max_wd_rise')}, "
+                     f"{dr['final_live']}/{dr['final_population']} live at "
+                     f"the last window"
+                     + (f", evicted {dr['evicted']}" if dr["evicted"]
+                        else ""))
     sim = summary.get("similarity")
     if sim and sim.get("avg_jsd_last") is not None:
         wd = (f" avg_wd {sim['avg_wd_last']}"

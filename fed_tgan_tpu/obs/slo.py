@@ -34,6 +34,13 @@ inputs the events are first folded into flat figures:
 - ``promotion_promoted`` / ``promotion_rejected`` ->
   ``quality/avg_jsd|avg_wd|jsd_delta|wd_delta|ml_acc_delta`` (worst
   observed -- the canary gate's shadow scores)
+- ``drift_window``    -> ``drift/windows|alarms_total|evicted_total``
+  (counts), ``drift/max_jsd_rise|max_wd_rise|recompute_lag_rounds``
+  (worst observed), ``drift/final_live`` (last event wins) -- the
+  elastic-federation drift trajectory
+- ``client_joined`` / ``client_left`` -> ``churn/joins_total``,
+  ``churn/join_repacks`` (admissions that forced a bucket repack, i.e.
+  a recompile -- budgeted to 0 inside capacity), ``churn/leaves_total``
 
 and ``metric`` is looked up as an exact figure key (program names may
 contain dots/brackets, so no dotted traversal on journal figures).
@@ -175,6 +182,32 @@ def journal_figures(events: List[dict]) -> Dict[str, float]:
                     key = f"quality/{k}"
                     val = float(ev[k])
                     figures[key] = max(figures.get(key, val), val)
+        elif kind == "drift_window":
+            figures["drift/windows"] = figures.get("drift/windows", 0.0) + 1
+            figures["drift/alarms_total"] = (
+                figures.get("drift/alarms_total", 0.0)
+                + float(ev.get("alarms", 0) or 0))
+            evicted = ev.get("evicted")
+            figures["drift/evicted_total"] = (
+                figures.get("drift/evicted_total", 0.0)
+                + float(len(evicted) if isinstance(evicted, list) else 0))
+            for k in ("max_jsd_rise", "max_wd_rise",
+                      "recompute_lag_rounds"):
+                if isinstance(ev.get(k), (int, float)):
+                    key = f"drift/{k}"
+                    val = float(ev[k])
+                    figures[key] = max(figures.get(key, val), val)
+            if isinstance(ev.get("live"), (int, float)):
+                figures["drift/final_live"] = float(ev["live"])
+        elif kind == "client_joined":
+            figures["churn/joins_total"] = (
+                figures.get("churn/joins_total", 0.0) + 1)
+            figures["churn/join_repacks"] = (
+                figures.get("churn/join_repacks", 0.0)
+                + float(bool(ev.get("repacked"))))
+        elif kind == "client_left":
+            figures["churn/leaves_total"] = (
+                figures.get("churn/leaves_total", 0.0) + 1)
     return figures
 
 

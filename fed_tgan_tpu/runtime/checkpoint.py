@@ -243,6 +243,22 @@ def save_federated(trainer, path: str, run_name: str | None = None,
         "ema": has_ema,
         "ema_updates": getattr(trainer, "_ema_updates", 0),
         "kind": "mdgan" if is_mdgan else "federated",
+        # elastic slot count (0 = legacy exact-population trainer): resume
+        # must rebuild the same padded stacks or the saved model leaves
+        # (leading capacity axis) would not fit the template
+        "capacity": (getattr(trainer, "capacity", 0)
+                     if getattr(trainer, "elastic", False) else 0),
+        # live-population state (churn): departures, the survivor-
+        # renormalized weights and the quarantine strike ledger must
+        # survive a rollback — a restored run must NOT resurrect departed
+        # clients or forget a repeat offender's record
+        "dropped_clients": sorted(
+            int(i) for i in (getattr(trainer, "dropped_clients", None) or ())
+        ),
+        "weights": (None if is_mdgan
+                    else np.asarray(trainer.weights).copy()),
+        "strikes": (None if is_mdgan
+                    else np.asarray(trainer._strikes).copy()),
         "init": trainer.init,
         "cfg": trainer.cfg,
         "seed": trainer.seed,
@@ -312,7 +328,11 @@ def load_federated(path: str, mesh=None):
         )
 
     cls = MDGANTrainer if kind == "mdgan" else FederatedTrainer
-    trainer = cls(host["init"], config=host["cfg"], mesh=mesh, seed=host["seed"])
+    kwargs = {}
+    if kind == "federated" and host.get("capacity", 0):
+        kwargs["capacity"] = int(host["capacity"])
+    trainer = cls(host["init"], config=host["cfg"], mesh=mesh,
+                  seed=host["seed"], **kwargs)
     with np.load(os.path.join(path, _ARRAYS)) as data:
         if kind == "mdgan":
             trainer.gen, trainer.disc = _load_leaves(
@@ -342,6 +362,24 @@ def load_federated(path: str, mesh=None):
             trainer._key = jax.device_put(
                 trainer._key, NamedSharding(trainer.mesh, P())
             )
+    if kind == "federated":
+        # replay the live-population state: departed clients stay departed
+        # (zero steps, zero weight), survivors keep their renormalized —
+        # and possibly drift-recomputed — weights, repeat offenders keep
+        # their strikes.  Host arrays only: the device stacks upload from
+        # them on the first fit(), so no extra transfers and no recompile.
+        dropped = host.get("dropped_clients") or []
+        if dropped:
+            trainer.dropped_clients = {int(i) for i in dropped}
+            alive = np.ones(len(trainer.steps), dtype=bool)
+            alive[sorted(trainer.dropped_clients)] = False
+            trainer.steps = np.where(alive, trainer.steps, 0)
+        w = host.get("weights")
+        if w is not None and np.shape(w) == np.shape(trainer.weights):
+            trainer.weights = np.asarray(w, dtype=np.float32).copy()
+        s = host.get("strikes")
+        if s is not None and np.shape(s) == np.shape(trainer._strikes):
+            trainer._strikes = np.asarray(s, dtype=np.int64).copy()
     trainer.completed_epochs = host["completed_epochs"]
     trainer.epoch_times = list(host["epoch_times"])
     if hasattr(trainer, "phase_times"):

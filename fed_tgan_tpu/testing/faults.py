@@ -45,6 +45,27 @@ A ``FaultPlan`` describes failures to inject at exact, reproducible points:
   under sync aggregation the fault is inert (a real straggler would
   simply stall the barrier, which is the behavior buffered mode exists
   to remove).
+- ``join:round=E[,count=N]`` — ``N`` (default 1) newcomers are admitted
+  to the live federation at the start of round ``E`` (1-based).  The
+  churn driver (``federation/elastic.py``) consumes this: it registers
+  the scripted newcomer shards via ``OnboardingSession.register_clients``
+  and repacks them into the resident population between rounds.
+- ``leave:client=C,round=E`` — resident client ``C`` (0-based population
+  index) departs at the start of round ``E``, routed through the
+  dropout/heartbeat path with survivor weight renormalization.
+- ``drift:client=C,round=E[,shift=S]`` — client ``C``'s shard is swapped
+  for a schema-stable, distribution-shifted version (continuous columns
+  translated by ``S`` local standard deviations, categorical masses
+  re-skewed; ``S`` defaults to 1.0) at the start of round ``E``.  The
+  swap is silent — only the per-window drift detector (sketch-scored
+  similarity vs the frozen references) can catch it; repeated kinds
+  accumulate, so several ``drift:`` entries script a trajectory.
+
+The churn kinds are host-side membership events consumed between fused
+round chunks (the round program itself never sees them); the chunked
+``fit`` loop lands chunk boundaries on scheduled churn rounds via
+:meth:`FaultPlan.next_churn_round`, the same edge-clipping contract as
+:func:`update_fault_window`.
 
 The update faults are baked into the jitted epoch program at trace time;
 the trainers force chunk boundaries at the window edges so fused rounds
@@ -97,10 +118,15 @@ class FaultPlan:
     corrupt_cache_nth: int = 0  # 0 = no cache-corruption fault
     degrade_factor: float = 0.0  # 0 = no snapshot-degrade fault
     degrade_nth: int = 1        # which published snapshot to degrade
+    # churn schedule: host-side membership events, 1-based rounds
+    joins: list = dataclasses.field(default_factory=list)   # [(round, count)]
+    leaves: list = dataclasses.field(default_factory=list)  # [(round, client)]
+    drifts: list = dataclasses.field(default_factory=list)  # [(round, client, shift)]
 
     VALID_KINDS = ("corrupt_cache", "crash_checkpoint", "degrade_snapshot",
-                   "delay_msg", "kill_client", "nan_update", "scale_update",
-                   "sever_conn", "straggle", "stuck_update")
+                   "delay_msg", "drift", "join", "kill_client", "leave",
+                   "nan_update", "scale_update", "sever_conn", "straggle",
+                   "stuck_update")
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
@@ -132,7 +158,7 @@ class FaultPlan:
                     args["factor"] = float(k)
                     continue
                 k = k.strip()
-                args[k] = float(v) if k == "factor" else int(v)
+                args[k] = float(v) if k in ("factor", "shift") else int(v)
             if name == "kill_client":
                 plan.kill_rank = args["rank"]
                 plan.kill_round = args["round"]
@@ -156,6 +182,33 @@ class FaultPlan:
                     )
                 plan.degrade_factor = float(args["factor"])
                 plan.degrade_nth = int(args.get("nth", 1))
+            elif name == "join":
+                if "round" not in args:
+                    # fail fast like the unknown-kind check: an unscheduled
+                    # join would silently never fire
+                    raise ValueError(
+                        f"join needs a round in spec {spec!r} "
+                        "(join:round=5 or join:round=5,count=2)"
+                    )
+                plan.joins.append((int(args["round"]),
+                                   max(1, int(args.get("count", 1)))))
+            elif name == "leave":
+                missing = [k for k in ("client", "round") if k not in args]
+                if missing:
+                    raise ValueError(
+                        f"leave needs {' and '.join(missing)} in spec "
+                        f"{spec!r} (leave:client=2,round=8)"
+                    )
+                plan.leaves.append((int(args["round"]), int(args["client"])))
+            elif name == "drift":
+                missing = [k for k in ("client", "round") if k not in args]
+                if missing:
+                    raise ValueError(
+                        f"drift needs {' and '.join(missing)} in spec "
+                        f"{spec!r} (drift:client=1,round=10,shift=2.0)"
+                    )
+                plan.drifts.append((int(args["round"]), int(args["client"]),
+                                    float(args.get("shift", 1.0))))
             elif name == "straggle":
                 plan.straggle_rank = int(args["rank"])
                 plan.straggle_delay = max(1, int(args.get("delay", 1)))
@@ -247,6 +300,36 @@ class FaultPlan:
         degrade_checkpoint(path, self.degrade_factor)
         return True
 
+    # -- churn schedule (host-side, consumed between fused chunks) ------------
+
+    def has_churn(self) -> bool:
+        return bool(self.joins or self.leaves or self.drifts)
+
+    def churn_events(self, e0: int) -> list:
+        """Membership events due at the start of 0-based round ``e0``.
+
+        Returns ``("join", count)`` / ``("leave", client)`` /
+        ``("drift", client, shift)`` tuples in spec order (joins first,
+        then leaves, then drifts — so a scripted leave at the same round
+        as a join acts on the pre-join population only if spec'd with a
+        lower client index, which stays stable either way: leaves name
+        population indices, joins append).
+        """
+        due: list = []
+        due += [("join", n) for r, n in self.joins if r - 1 == e0]
+        due += [("leave", c) for r, c in self.leaves if r - 1 == e0]
+        due += [("drift", c, s) for r, c, s in self.drifts if r - 1 == e0]
+        return due
+
+    def next_churn_round(self, e0: int) -> Optional[int]:
+        """Smallest 0-based round ``>= e0`` with a scheduled churn event,
+        or None.  The chunked fit loop clips fused chunks to this edge so
+        membership mutation always lands on a chunk boundary — the same
+        window contract as :func:`update_fault_window`."""
+        rounds = [r - 1 for r, *_ in (*self.joins, *self.leaves, *self.drifts)
+                  if r - 1 >= e0]
+        return min(rounds) if rounds else None
+
 
 def update_fault_window(
     plan: Optional[FaultPlan], e0: int, size: int
@@ -300,6 +383,41 @@ def straggle_window(
     straggler = ((plan.straggle_rank - 1, plan.straggle_delay)
                  if active else None)
     return straggler, size
+
+
+def drift_frame(frame, shift: float, seed: int):
+    """Deterministically drift a client shard, schema-stable.
+
+    Continuous columns are translated by ``shift`` local standard
+    deviations (mean/mode structure moves, support stays finite);
+    categorical columns keep their exact vocabulary but re-skew toward
+    a seeded permutation of it (probability mass rotates, no new
+    categories — the frozen-reference screen in streaming registration
+    must keep accepting the shard).  Same (frame, shift, seed) → same
+    output, bit-for-bit; dtypes and column order are preserved.
+    """
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    out = {}
+    for col in frame.columns:
+        s = frame[col]
+        if pd.api.types.is_numeric_dtype(s) and s.nunique() > 2:
+            std = float(s.std())
+            out[col] = (s + shift * (std if std > 0 else 1.0)).astype(s.dtype)
+        else:
+            vals = np.asarray(sorted(pd.unique(s.astype(str))))
+            # rotate mass: each row flips to the "next" category with
+            # probability min(0.8, 0.35 * shift) — vocabulary unchanged
+            nxt = {v: vals[(i + 1) % len(vals)]
+                   for i, v in enumerate(vals)}
+            flip = rng.random(len(s)) < min(0.8, 0.35 * abs(shift))
+            drifted = s.astype(str).to_numpy().copy()
+            if flip.any():
+                drifted[flip] = np.array([nxt[v] for v in drifted[flip]])
+            out[col] = pd.Series(drifted, index=s.index).astype(s.dtype)
+    return pd.DataFrame(out, index=frame.index)[list(frame.columns)]
 
 
 def degrade_checkpoint(path: str, factor: float) -> str:

@@ -69,6 +69,11 @@ _DROPPED_TOTAL = _metric_counter(
     "clients dropped from the federation")
 
 
+def _next_pow2(n: int) -> int:
+    """Smallest power of two >= n (bucketing for elastic trace shapes)."""
+    return 1 << (max(1, int(n)) - 1).bit_length()
+
+
 def _pad_to(arr: jax.Array | np.ndarray, size: int, axis: int = 0) -> np.ndarray:
     arr = np.asarray(arr)
     pad = size - arr.shape[axis]
@@ -606,6 +611,7 @@ class FederatedTrainer(RoundBookkeeping):
         seed: int = 0,
         min_clients: int = 1,
         quarantine_strikes: int = 3,
+        capacity: int = 0,
     ):
         self.init = init
         self.cfg = config or TrainConfig()
@@ -615,22 +621,40 @@ class FederatedTrainer(RoundBookkeeping):
         self.dropped_clients: set[int] = set()
         n_clients = len(init.client_matrices)
         self.n_clients = n_clients
-        # per-client count of rounds the update gate rejected; reaching
-        # quarantine_strikes evicts the client (down to min_clients)
-        self._strikes = np.zeros(n_clients, dtype=np.int64)
+        # capacity > 0 opts into ELASTIC membership: the stacks are padded
+        # with zero-weight / zero-step slots up to `capacity` and the
+        # trace-time shape constants (rows, scan length) are bucketed to
+        # pow2, so a later `admit_clients` that fits the buckets re-uploads
+        # data without recompiling the round program.  capacity == 0 keeps
+        # the exact legacy shapes — every compiled program byte-identical.
+        if capacity and capacity < n_clients:
+            raise ValueError(
+                f"capacity={capacity} below the resident population "
+                f"{n_clients}: elastic slots can only add headroom"
+            )
+        self.elastic = bool(capacity)
+        sched = capacity or n_clients  # slot count the mesh must schedule
         if mesh is None:
             n_dev = len(jax.devices())
-            if n_clients % n_dev == 0:
-                mesh = client_mesh()  # k = n_clients / n_dev participants each
-            elif n_clients < n_dev:
-                mesh = client_mesh(n_clients)
+            if sched % n_dev == 0:
+                mesh = client_mesh()  # k = slots / n_dev participants each
+            elif sched < n_dev:
+                mesh = client_mesh(sched)
             else:
                 raise ValueError(
-                    f"n_clients={n_clients} not schedulable on {n_dev} devices: "
+                    f"n_clients={sched} not schedulable on {n_dev} devices: "
                     "must divide evenly or fit one-per-device"
                 )
         self.mesh = mesh
-        self.k = clients_per_device(n_clients, self.mesh)
+        if capacity and capacity % self.mesh.devices.size:
+            # round requested headroom up to a schedulable slot count
+            nd = self.mesh.devices.size
+            capacity = -(-capacity // nd) * nd
+        self.capacity = capacity or n_clients
+        self.k = clients_per_device(self.capacity, self.mesh)
+        # per-client count of rounds the update gate rejected; reaching
+        # quarantine_strikes evicts the client (down to min_clients)
+        self._strikes = np.zeros(self.capacity, dtype=np.int64)
         if self.cfg.aggregation not in ("sync", "buffered"):
             raise ValueError(
                 f"aggregation={self.cfg.aggregation!r}: expected sync|buffered"
@@ -669,6 +693,16 @@ class FederatedTrainer(RoundBookkeeping):
                     rows=int(sum(m.shape[0] for m in init.client_matrices)))
         self.max_steps = int(self.steps.max())
         self.weights = np.asarray(init.weights, dtype=np.float32)
+        self._rows_bucket = int(self.data_stack.shape[1])
+        if self.elastic:
+            # pow2 buckets on the trace-time shape constants: a newcomer
+            # whose shard fits them lands via data re-upload alone
+            self.max_steps = _next_pow2(max(1, self.max_steps))
+            self._rows_bucket = _next_pow2(self._rows_bucket)
+            (self.cond_stack, self.rows_stack, self.data_stack, self.steps,
+             self.weights) = self._pad_population(
+                self.cond_stack, self.rows_stack, self.data_stack,
+                self.steps, self.weights)
         if (self.cfg.precision == "bf16"
                 and not np.isclose(self.weights.sum(), 1.0, atol=1e-4)):
             # the bf16 delta path re-anchors on prev and assumes
@@ -690,7 +724,8 @@ class FederatedTrainer(RoundBookkeeping):
         self._key = jax.device_put(self._key, NamedSharding(self.mesh, P()))
         one = init_models(init_key, self.spec, self.cfg)
         self.models = jax.tree.map(
-            lambda x: np.broadcast_to(np.asarray(x)[None], (n_clients,) + np.shape(x)).copy(),
+            lambda x: np.broadcast_to(
+                np.asarray(x)[None], (self.capacity,) + np.shape(x)).copy(),
             one,
         )
         # EMA of the aggregated generator (cfg.ema_decay > 0): one
@@ -825,17 +860,22 @@ class FederatedTrainer(RoundBookkeeping):
                 ei = e + r
                 if cohort is not None:
                     ids = np.asarray(cohort)[r].astype(int)
+                    sel = slice(None)  # columns already = sampled cohort
                 else:
+                    # resident population only: padded elastic slots (ids
+                    # >= n_clients, weight 0, steps 0) stay out of the
+                    # ledger — they are capacity, not clients
                     ids = np.arange(self.n_clients)
-                qrow = (np.asarray(quar)[r] > 0.5 if quar is not None
+                    sel = ids
+                qrow = (np.asarray(quar)[r][sel] > 0.5 if quar is not None
                         else np.zeros(ids.size, dtype=bool))
                 _emit_event(
                     "client_contribution", round=ei, first=e,
                     rounds_per_program=size,
                     clients=[int(i) for i in ids],
                     weights=[_num(self.weights[i]) for i in ids],
-                    loss_d=[_num(v) for v in loss_d[r]],
-                    loss_g=[_num(v) for v in loss_g[r]],
+                    loss_d=[_num(v) for v in loss_d[r][sel]],
+                    loss_g=[_num(v) for v in loss_g[r][sel]],
                     quarantined=[int(b) for b in qrow],
                     strikes=[int(self._strikes[i]) for i in ids],
                 )
@@ -854,6 +894,258 @@ class FederatedTrainer(RoundBookkeeping):
                           labels=lab).set(float(self._strikes[i]))
         except Exception:  # noqa: BLE001 -- obs must never kill training
             pass
+
+    def _pad_population(self, cond_stack, rows_stack, data_stack, steps,
+                        weights):
+        """Pad the live population's stacks up to ``self.capacity`` slots.
+
+        Padding slots train 0 steps and carry weight 0, so the aggregation
+        gate never considers (or quarantines) them; their sampler tables
+        duplicate client 0's so every masked-out step stays numerically
+        well-conditioned.  Row-bearing axes are padded to
+        ``self._rows_bucket`` first — the bucketed trace shape a later
+        admission must fit to avoid recompiling.
+        """
+        import dataclasses as _dc
+
+        data_stack = _pad_to(data_stack, self._rows_bucket, axis=1)
+        # the CSR row pool is the one sampler leaf whose size follows the
+        # shard's row count: n_discrete pools of n_rows indices each
+        pool_len = max(1, self.spec.n_discrete * self._rows_bucket)
+        rows_stack = _dc.replace(
+            rows_stack,
+            row_pool=_pad_to(np.asarray(rows_stack.row_pool), pool_len,
+                             axis=1),
+        )
+        pad = self.capacity - len(steps)
+        if pad > 0:
+            dup = lambda x: np.concatenate(
+                [np.asarray(x),
+                 np.repeat(np.asarray(x)[:1], pad, axis=0)], axis=0)
+            cond_stack = jax.tree.map(dup, cond_stack)
+            rows_stack = jax.tree.map(dup, rows_stack)
+            data_stack = _pad_to(data_stack, self.capacity, axis=0)
+            steps = np.concatenate(
+                [np.asarray(steps), np.zeros(pad, dtype=np.int32)])
+            weights = np.concatenate(
+                [np.asarray(weights, dtype=np.float32),
+                 np.zeros(pad, dtype=np.float32)])
+        return cond_stack, rows_stack, data_stack, steps, weights
+
+    def admit_clients(self, new_init: FederatedInit, reason: str = "join"):
+        """Admit newcomers between rounds (elastic membership).
+
+        ``new_init`` is the grown ``FederatedInit`` from
+        ``OnboardingSession.register_clients`` — the first ``n_clients``
+        shards are the residents (their matrices untouched; similarity
+        weights legitimately re-softmaxed over the larger population) and
+        every shard beyond them is a newcomer.
+
+        Requires ``capacity > 0`` at construction.  While the newcomers fit
+        the existing buckets (slot count, pow2 row bucket, scan length) the
+        admission is a pure data re-upload: the padded slots already hold
+        the current global parameters with fresh optimizer moments (every
+        round's replicated aggregate overwrites ALL slots' params, and a
+        0-step slot never touches its Adam state), so no model surgery and
+        ZERO new compiled programs.  Overflowing a bucket triggers an
+        explicit repack — buckets regrow and the epoch-program cache is
+        cleared (one deliberate recompile, journaled via the emitted
+        events' ``repacked`` flag).
+
+        Dropped residents stay dropped: their weight is re-zeroed and the
+        survivor renormalization re-applied over the new population.
+        """
+        if not self.elastic:
+            raise RuntimeError(
+                "admit_clients needs an elastic trainer: construct "
+                "FederatedTrainer(..., capacity=N) with headroom slots"
+            )
+        n_new = len(new_init.client_matrices) - self.n_clients
+        if n_new <= 0:
+            raise ValueError(
+                f"new_init holds {len(new_init.client_matrices)} shards, "
+                f"not more than the {self.n_clients} residents — nothing "
+                "to admit"
+            )
+        n_total = len(new_init.client_matrices)
+        n_dev = self.mesh.devices.size
+        repacked = False
+        if n_total > self.capacity:
+            cap = _next_pow2(n_total)
+            self.capacity = cap if cap % n_dev == 0 else -(-cap // n_dev) * n_dev
+            repacked = True
+        t_pack = time.perf_counter()
+        with _span("init.shard_packing", clients=n_total):
+            (cond_stack, rows_stack, data_stack, steps,
+             self.server_cond) = build_client_stacks(new_init, self.cfg,
+                                                     self.spec)
+        if int(data_stack.shape[1]) > self._rows_bucket:
+            self._rows_bucket = _next_pow2(int(data_stack.shape[1]))
+            repacked = True
+        if int(steps.max()) > self.max_steps:
+            self.max_steps = _next_pow2(int(steps.max()))
+            repacked = True
+        if repacked:
+            # deliberate recompile: the next fit() chunk rebuilds the epoch
+            # program at the regrown bucket shapes
+            self._epoch_fns.clear()
+            self.k = clients_per_device(self.capacity, self.mesh)
+            grow = self.capacity - len(self._strikes)
+            if grow > 0:
+                self._strikes = np.concatenate(
+                    [self._strikes, np.zeros(grow, dtype=np.int64)])
+                self.models = jax.tree.map(
+                    lambda x: np.concatenate(
+                        [np.asarray(x),
+                         np.repeat(np.asarray(x)[:1], grow, axis=0)],
+                        axis=0),
+                    self.models,
+                )
+        weights = np.asarray(new_init.weights, dtype=np.float32)
+        if self.dropped_clients:
+            alive = np.ones(n_total, dtype=bool)
+            alive[list(self.dropped_clients)] = False
+            weights = renormalize_weights(weights, alive)
+            steps = np.where(alive, steps, 0)
+        (self.cond_stack, self.rows_stack, self.data_stack, self.steps,
+         self.weights) = self._pad_population(
+            cond_stack, rows_stack, data_stack, steps, weights)
+        first_new = self.n_clients
+        self.init = new_init
+        self.n_clients = n_total
+        if self._device_stacks is not None:
+            if repacked:
+                self._device_stacks = None  # shapes moved; re-upload in fit
+            else:
+                self._device_stacks = (
+                    self._shard(jnp.asarray(self.data_stack)),
+                    self._shard(self.cond_stack),
+                    self._shard(self.rows_stack),
+                    self._shard(jnp.asarray(self.steps)),
+                    self._shard(jnp.asarray(self.weights)),
+                )
+        _emit_event("init_phase", phase="shard_packing",
+                    seconds=round(time.perf_counter() - t_pack, 6),
+                    clients=n_total,
+                    rows=int(sum(m.shape[0]
+                                 for m in new_init.client_matrices)))
+        for idx in range(first_new, n_total):
+            _emit_event(
+                "client_joined", client=int(idx), round=self.completed_epochs,
+                population=n_total, capacity=int(self.capacity),
+                weight=round(float(self.weights[idx]), 8),
+                rows=int(new_init.client_matrices[idx].shape[0]),
+                repacked=bool(repacked), reason=reason)
+        import logging
+
+        logging.getLogger("fed_tgan_tpu.train").info(
+            "admitted %d newcomer(s) (population %d -> %d, capacity %d%s)",
+            n_new, first_new, n_total, self.capacity,
+            ", repacked" if repacked else "",
+        )
+        return self
+
+    def update_client_shard(self, idx: int, matrix: np.ndarray) -> None:
+        """Swap client ``idx``'s training rows between rounds (drift).
+
+        Rebuilds the client's sampler tables, data rows and step budget in
+        place and re-uploads the stacks; while the new shard fits the
+        elastic buckets this never recompiles (data moved, shapes did not).
+        The model slice is untouched — a drifted client keeps its training
+        state and simply sees its new distribution next round.
+        """
+        if not 0 <= idx < self.n_clients:
+            raise IndexError(f"client index {idx} out of range")
+        matrix = np.asarray(matrix, dtype=np.float32)
+        if self.elastic and len(matrix) > self._rows_bucket:
+            self._rows_bucket = _next_pow2(len(matrix))
+            self._epoch_fns.clear()
+            self.data_stack = _pad_to(self.data_stack, self._rows_bucket,
+                                      axis=1)
+            pool_len = max(1, self.spec.n_discrete * self._rows_bucket)
+            import dataclasses as _dc
+
+            self.rows_stack = _dc.replace(
+                self.rows_stack,
+                row_pool=_pad_to(np.asarray(self.rows_stack.row_pool),
+                                 pool_len, axis=1),
+            )
+        elif len(matrix) > self.data_stack.shape[1]:
+            raise ValueError(
+                f"drifted shard for client {idx} holds {len(matrix)} rows, "
+                f"beyond the packed {self.data_stack.shape[1]}; construct "
+                "the trainer with capacity=N for elastic row buckets"
+            )
+        steps = len(matrix) // self.cfg.batch_size
+        if steps == 0 and not self.cfg.allow_zero_step_clients:
+            raise ValueError(
+                f"drifted shard for client {idx} holds fewer than "
+                f"batch_size={self.cfg.batch_size} rows"
+            )
+        if steps > self.max_steps:
+            if not self.elastic:
+                raise ValueError(
+                    f"drifted shard for client {idx} needs {steps} local "
+                    f"steps, beyond the compiled {self.max_steps}"
+                )
+            self.max_steps = _next_pow2(steps)
+            self._epoch_fns.clear()
+        cond = CondSampler.from_data(matrix, self.spec)
+        rows = RowSampler.from_data(matrix, self.spec)
+
+        def put(stack_leaf, new_leaf):
+            arr = np.array(stack_leaf, copy=True)
+            new = np.asarray(new_leaf)
+            slot = np.zeros(arr.shape[1:], dtype=arr.dtype)
+            if new.ndim == 0:
+                slot = new.astype(arr.dtype)
+            else:
+                slot[tuple(slice(0, s) for s in new.shape)] = new
+            arr[idx] = slot
+            return arr
+
+        self.cond_stack = jax.tree.map(put, self.cond_stack, cond)
+        self.rows_stack = jax.tree.map(put, self.rows_stack, rows)
+        self.data_stack[idx] = _pad_to(matrix, self.data_stack.shape[1])
+        self.steps = np.asarray(self.steps).copy()
+        self.steps[idx] = 0 if idx in self.dropped_clients else steps
+        if len(self.init.client_matrices) > idx:
+            self.init.client_matrices[idx] = matrix
+        if self._device_stacks is not None:
+            self._device_stacks = (
+                self._shard(jnp.asarray(self.data_stack)),
+                self._shard(self.cond_stack),
+                self._shard(self.rows_stack),
+                self._shard(jnp.asarray(self.steps)),
+                self._shard(jnp.asarray(self.weights)),
+            )
+
+    def update_weights(self, weights: np.ndarray) -> None:
+        """Install freshly recomputed similarity weights (drift windows).
+
+        Dropped clients are re-zeroed and survivors renormalized, then the
+        weights device array is re-uploaded — same no-recompile contract
+        as :meth:`drop_client`.
+        """
+        w = np.asarray(weights, dtype=np.float32)
+        if w.shape[0] == self.n_clients and len(self.weights) > self.n_clients:
+            w = np.concatenate(
+                [w, np.zeros(len(self.weights) - self.n_clients,
+                             dtype=np.float32)])
+        if w.shape != np.shape(self.weights):
+            raise ValueError(
+                f"weights shape {w.shape} does not match the packed "
+                f"population {np.shape(self.weights)}"
+            )
+        alive = np.ones(len(w), dtype=bool)
+        alive[list(self.dropped_clients)] = False
+        self.weights = renormalize_weights(w, alive)
+        if self._device_stacks is not None:
+            data, cond, rows, steps, _ = self._device_stacks
+            self._device_stacks = (
+                data, cond, rows, steps,
+                self._shard(jnp.asarray(self.weights)),
+            )
 
     def drop_client(self, idx: int, reason: str = "") -> None:
         """Drop client ``idx`` (0-based) from all future rounds.
@@ -879,7 +1171,7 @@ class FederatedTrainer(RoundBookkeeping):
         _DROPPED_TOTAL.inc()
         _emit_event("client_dropped", client=int(idx), reason=reason,
                     survivors=survivors)
-        alive = np.ones(self.n_clients, dtype=bool)
+        alive = np.ones(len(self.weights), dtype=bool)
         alive[list(self.dropped_clients)] = False
         self.weights = renormalize_weights(self.weights, alive)
         self.steps = np.where(alive, self.steps, 0)
@@ -1061,7 +1353,7 @@ class FederatedTrainer(RoundBookkeeping):
                 # the straggler leaves this round's barrier: its weight is
                 # masked to 0 and survivors renormalized — an ad-hoc upload,
                 # self.weights and the resident stacks stay untouched
-                alive = np.ones(self.n_clients, dtype=bool)
+                alive = np.ones(len(self.weights), dtype=bool)
                 alive[list(self.dropped_clients)] = False
                 alive[straggle_idx] = False
                 weights_call = self._shard(
@@ -1176,7 +1468,7 @@ class FederatedTrainer(RoundBookkeeping):
                         # j-th SAMPLED participant, so strikes are charged
                         # through the sampled global ids
                         ids = np.asarray(metrics_host["cohort"])
-                        counts = np.zeros(self.n_clients, dtype=np.int64)
+                        counts = np.zeros(len(self._strikes), dtype=np.int64)
                         np.add.at(counts, ids[q].ravel(), 1)
                     else:
                         counts = q.sum(axis=0).astype(np.int64)

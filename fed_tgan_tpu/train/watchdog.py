@@ -51,6 +51,7 @@ class WatchdogConfig:
     similarity_patience: int = 3      # consecutive bad monitor reads
     max_rollbacks: int = 2            # rollbacks before clean abort
     lr_reanneal: float = 0.5          # lr multiplier on each rollback
+    drift_patience: int = 2           # consecutive drifted windows/client
 
 
 class TrainingWatchdog:
@@ -61,11 +62,13 @@ class TrainingWatchdog:
         self.rollbacks = 0
         self._best_jsd: float | None = None
         self._bad_streak = 0
+        self._drift_streaks: dict[int, int] = {}
 
     def reset_window(self) -> None:
         """Forget in-flight streaks (called after a rollback, NOT the
         rollback counter — that bounds the whole run)."""
         self._bad_streak = 0
+        self._drift_streaks.clear()
 
     # -- trainer hook (FederatedTrainer.fit(health_cb=...)) -----------------
 
@@ -123,6 +126,34 @@ class TrainingWatchdog:
                 )
         else:
             self._bad_streak = 0
+
+    # -- drift-detector hook (federation/elastic.py) -------------------------
+
+    def observe_drift(self, round_idx: int,
+                      drifted: "list[int]") -> "list[int]":
+        """Feed one detection window's per-client drift verdicts.
+
+        ``drifted`` names the clients whose per-window similarity scores
+        crossed the alarm thresholds.  Unlike loss explosions, drift is
+        data, not corruption: rolling back the MODEL cannot undrift a
+        client's shard, so this hook never raises.  Instead it tracks
+        per-client streaks and returns the clients whose drift persisted
+        ``drift_patience`` consecutive windows — candidates for the
+        quarantine strike machinery (the caller charges strikes, and the
+        trainer's existing eviction path handles repeat offenders).  A
+        window without a client's drift clears that client's streak (a
+        transient blip, or the online refit already absorbed it).
+        """
+        hit = set(int(c) for c in drifted)
+        for c in list(self._drift_streaks):
+            if c not in hit:
+                del self._drift_streaks[c]
+        sustained = []
+        for c in sorted(hit):
+            self._drift_streaks[c] = self._drift_streaks.get(c, 0) + 1
+            if self._drift_streaks[c] >= self.cfg.drift_patience:
+                sustained.append(c)
+        return sustained
 
 
 def fit_with_watchdog(

@@ -124,6 +124,206 @@ def run_soak(seed: int = 0, epochs: int = 3, n_clients: int = 3,
     return out
 
 
+def run_churn_soak(seed: int = 0, epochs: int = 50, out_dir: str = None,
+                   rows: int = 1200) -> dict:
+    """Full churn + drift soak for the elastic-federation layer.
+
+    One deterministic scenario over ``epochs`` (>= 50 for the acceptance
+    run) rounds on an 8-virtual-device mesh: 4 resident clients with
+    capacity-16 headroom, two scripted join waves, two departures, three
+    scripted drift events (one repeated, so a sustained-drift strike is
+    charged), a buffered-aggregation straggler, a mid-run NaN update that
+    trips the watchdog into a checkpoint rollback, and per-window drift
+    detection.  Sanitizers stay armed for the join segments: an admission
+    inside capacity must add ZERO new ``epoch_local`` programs.
+
+    Artifacts under ``out_dir``: ``journal.jsonl`` (full run journal),
+    ``drift_trajectory.jsonl`` (the drift_window / membership event
+    stream — the ``obs slo`` gate input), and ``canary_scoreboard.json``
+    (final synthetic snapshot scored against pre-drift reference
+    statistics through the serve/canary scorer).
+    """
+    import json
+
+    import numpy as np
+
+    import jax
+
+    from fed_tgan_tpu.analysis.sanitizers import sanitize
+    from fed_tgan_tpu.data.decode import decode_matrix
+    from fed_tgan_tpu.data.ingest import TablePreprocessor
+    from fed_tgan_tpu.data.sharding import shard_dataframe
+    from fed_tgan_tpu.federation.elastic import (
+        DriftConfig,
+        ElasticFederation,
+    )
+    from fed_tgan_tpu.federation.init import federated_initialize
+    from fed_tgan_tpu.federation.streaming import OnboardingSession
+    from fed_tgan_tpu.obs.journal import RunJournal, read_journal, set_journal
+    from fed_tgan_tpu.obs.slo import check_slo, default_budgets_path
+    from fed_tgan_tpu.parallel.mesh import client_mesh
+    from fed_tgan_tpu.runtime.checkpoint import save_federated
+    from fed_tgan_tpu.serve.canary import compute_reference_stats, score_frame
+    from fed_tgan_tpu.testing.faults import FaultPlan, install_plan
+    from fed_tgan_tpu.train.federated import FederatedTrainer
+    from fed_tgan_tpu.train.steps import TrainConfig
+    from fed_tgan_tpu.train.watchdog import TrainingWatchdog, WatchdogConfig
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="churn_soak_")
+    os.makedirs(out_dir, exist_ok=True)
+    ckpt_dir = os.path.join(out_dir, "ckpt")
+    spec = dict(categorical_columns=["color", "flag"],
+                non_negative_columns=["amount"], target_column="flag",
+                problem_type="binary_classification")
+    frames = shard_dataframe(_toy_frame(rows, seed), 8, "iid", seed=seed)
+    residents = [TablePreprocessor(frame=f, **spec) for f in frames[:4]]
+    pool = [TablePreprocessor(frame=f, **spec) for f in frames[4:]]
+
+    # pre-drift pooled real data is the canary reference: the drift run's
+    # final snapshot scores against what the federation STARTED from
+    import pandas as pd
+
+    reference = compute_reference_stats(
+        pd.concat(frames[:4], ignore_index=True), ["color", "flag"],
+        name="churn_soak")
+
+    # scripted scenario (0-based internally, specs are 1-based rounds):
+    # joins at 9 and 21, departures at 15 and 34, drift on client 0 at 13
+    # and repeated on client 2 at 27/31/35 (3 consecutive detection
+    # windows -> sustained -> strikes), a buffered straggler, and one
+    # poisoned-but-FINITE update at 41 that must blow up the losses and
+    # trip the watchdog into a checkpoint rollback (a NaN would be eaten
+    # by the always-on finite screen in the aggregator and merely
+    # quarantine the sender — no rollback exercised)
+    n_epochs = max(int(epochs), 50)
+    plan_spec = (
+        "join:round=9,count=2;join:round=21,count=2;"
+        "leave:client=1,round=15;leave:client=5,round=34;"
+        "drift:client=0,round=13,shift=2.0;"
+        "drift:client=2,round=27,shift=2.5;"
+        "drift:client=2,round=31,shift=2.0;"
+        "drift:client=2,round=35,shift=2.0;"
+        "straggle:rank=3,delay=2,round=17,until=18;"
+        "scale_update:factor=1e6,rank=4,round=41,until=41"
+    )
+    cfg = TrainConfig(embedding_dim=8, gen_dims=(16, 16), dis_dims=(16, 16),
+                      batch_size=40, pac=4, aggregation="buffered",
+                      # gate off: the poisoned update must reach the losses
+                      # so the WATCHDOG path (alarm -> checkpoint rollback)
+                      # is what this soak exercises; the norm gate has its
+                      # own soak (run_soak's random draws)
+                      update_gate=False)
+    journal = RunJournal(os.path.join(out_dir, "journal.jsonl"),
+                         run_id=f"churn-soak-{seed}")
+    prev = set_journal(journal)
+    install_plan(FaultPlan.parse(plan_spec))
+    out = {"seed": seed, "epochs": n_epochs, "out_dir": out_dir,
+           "outcome": None, "detail": "", "join_compiles": None}
+    try:
+        init = federated_initialize(residents, seed=seed, backend="jax",
+                                    similarity="sketch")
+        watchdog = TrainingWatchdog(WatchdogConfig(
+            max_rollbacks=3, drift_patience=2))
+        with sanitize(transfer_guard=False) as counter:
+            trainer = FederatedTrainer(
+                init, config=cfg, mesh=client_mesh(8), seed=seed,
+                min_clients=2, quarantine_strikes=3, capacity=16)
+            elastic = ElasticFederation(
+                trainer, OnboardingSession(init), residents,
+                watchdog=watchdog,
+                config=DriftConfig(detect_every=4))
+
+            cursor = {"n": 0}
+
+            def newcomers(count, _round):
+                batch = pool[cursor["n"]:cursor["n"] + count]
+                cursor["n"] += count
+                return batch
+
+            # per-hook-round compile census: the straggle rounds (17-18)
+            # compile size-1 fused programs and the watchdog rollback at
+            # ~41 recompiles everything (lr re-anneal flushes _epoch_fns),
+            # both legitimately — so the zero-recompile-on-join claim is
+            # checked over the two hook spans that bracket ONLY the joins
+            compile_marks = {}
+
+            def hook(e, tr):
+                compile_marks[e] = counter.count("epoch_local")
+                save_federated(tr, ckpt_dir, run_name="churn_soak", keep=2)
+
+            elastic.run(
+                n_epochs, ckpt_dir=ckpt_dir,
+                newcomer_factory=newcomers,
+                fit_kwargs={
+                    "sample_hook": hook,
+                    "hook_epochs": list(range(1, n_epochs, 2)),
+                    "max_rounds_per_call": 4,
+                },
+                # the restored run re-traverses the poisoned round; clear
+                # the update fault (drop the churn specs too — those
+                # events are applied-once and guarded upstream)
+                on_rollback=lambda tr: install_plan(
+                    FaultPlan.parse("straggle:rank=3,delay=2,round=17,"
+                                    "until=18")),
+            )
+            trainer = elastic.trainer  # rollback replaces the instance
+            # every join landed inside capacity: the epoch program count
+            # must not move across either join (0-based rounds 8 and 20,
+            # each bracketed by the hooks one round to either side)
+            out["join_compiles"] = (
+                (compile_marks.get(9, 0) - compile_marks.get(7, 0))
+                + (compile_marks.get(21, 0) - compile_marks.get(19, 0)))
+        out["outcome"] = "completed"
+        out["rollbacks"] = watchdog.rollbacks
+        out["buffered_applied"] = trainer._buffered_applied
+        out["population"] = trainer.n_clients
+        out["dropped"] = sorted(trainer.dropped_clients)
+        out["windows"] = len(elastic.windows)
+        out["alarms"] = sum(w["alarms"] for w in elastic.windows)
+        out["finite_params"] = all(
+            bool(np.isfinite(np.asarray(leaf)).all())
+            for leaf in jax.tree.leaves(trainer.models.params_g))
+
+        # canary scoreboard: final synthetic snapshot vs pre-drift
+        # reference, gated by the same quality-* budget rules the live
+        # promotion gate uses
+        synth = decode_matrix(trainer.sample(2000, seed=seed),
+                              init.global_meta, init.encoders)
+        scores = score_frame(reference, synth)
+        scoreboard = {
+            "avg_jsd": scores["avg_jsd"], "avg_wd": scores["avg_wd"],
+            "per_column": scores["per_column"],
+            "reference": "pre-drift pooled residents",
+        }
+        with open(os.path.join(out_dir, "canary_scoreboard.json"),
+                  "w") as fh:
+            json.dump(scoreboard, fh, indent=2, sort_keys=True)
+        out["canary_avg_jsd"] = round(float(scores["avg_jsd"]), 6)
+        out["canary_avg_wd"] = round(float(scores["avg_wd"]), 6)
+    except (RuntimeError, ValueError) as e:  # sanctioned clean abort
+        out["outcome"] = "aborted"
+        out["detail"] = f"{type(e).__name__}: {e}"
+    finally:
+        install_plan(None)
+        set_journal(prev)
+        journal.close()
+
+    # drift trajectory artifact: the membership/drift event stream, one
+    # JSON line per event, checked against the drift-*/churn-* budgets
+    traj_path = os.path.join(out_dir, "drift_trajectory.jsonl")
+    kinds = ("drift_window", "drift_alarm", "client_joined", "client_left")
+    with open(traj_path, "w") as fh:
+        for ev in read_journal(journal.path):
+            if ev.get("type") in kinds:
+                fh.write(json.dumps(ev, default=str) + "\n")
+    out["trajectory"] = traj_path
+    if out["outcome"] == "completed":
+        code, lines = check_slo(traj_path, default_budgets_path())
+        out["slo_exit"] = code
+        out["slo_lines"] = [ln for ln in lines if "REGRESSION" in ln]
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=None,
@@ -133,7 +333,34 @@ def main(argv=None) -> int:
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--clients", type=int, default=3)
     ap.add_argument("--rows", type=int, default=240)
+    ap.add_argument("--churn", action="store_true",
+                    help="run the scripted churn+drift elastic-federation "
+                         "soak instead of the randomized fault soak "
+                         "(>= 50 rounds; writes journal, drift trajectory "
+                         "and canary scoreboard artifacts)")
+    ap.add_argument("--out-dir", type=str, default=None,
+                    help="--churn: artifact directory (default: tempdir)")
     args = ap.parse_args(argv)
+
+    if args.churn:
+        r = run_churn_soak(seed=args.seed or 0,
+                           epochs=max(args.epochs, 50),
+                           out_dir=args.out_dir)
+        ok = (r["outcome"] == "completed" and r.get("finite_params")
+              and r.get("join_compiles") == 0
+              and r.get("rollbacks", 0) >= 1
+              and r.get("alarms", 0) >= 1
+              # the scripted departures survive the rollback's checkpoint
+              # restore — rolled-back runs must not resurrect the departed
+              and r.get("dropped") == [1, 5]
+              and r.get("slo_exit") == 0)
+        for k in sorted(r):
+            if k not in ("slo_lines",):
+                print(f"  {k}: {r[k]}")
+        for ln in r.get("slo_lines", []):
+            print(f"  {ln}")
+        print("churn soak " + ("OK" if ok else "FAILED"))
+        return 0 if ok else 1
 
     seeds = [args.seed] if args.seed is not None else list(range(args.seeds))
     failures = 0

@@ -1,0 +1,312 @@
+"""Elastic membership and drift (federation/elastic.py): mid-training
+joins land in the pow2-bucketed resident population with ZERO new
+compiled programs and bit-reproducible cohort draws across
+checkpoint/resume; departures renormalize the survivors through the
+PR 1 dropout path; a silently-swapped drifted shard is detected, refit,
+and re-weighted within ONE detection window; and the whole lifecycle
+narrates through the run journal into `obs report` / `obs slo`."""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from fed_tgan_tpu.analysis.sanitizers import sanitize
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.data.sharding import shard_dataframe
+from fed_tgan_tpu.federation.elastic import DriftConfig, ElasticFederation
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.federation.streaming import OnboardingSession
+from fed_tgan_tpu.obs.journal import RunJournal, read_journal, set_journal
+from fed_tgan_tpu.parallel.mesh import client_mesh
+from fed_tgan_tpu.testing.faults import FaultPlan
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+from fed_tgan_tpu.train.watchdog import TrainingWatchdog, WatchdogConfig
+
+pytestmark = pytest.mark.churn
+
+CFG = TrainConfig(embedding_dim=8, gen_dims=(16,), dis_dims=(16,),
+                  batch_size=20, pac=4)
+N_RES = 10      # founding residents
+N_POOL = 2      # newcomers waiting to join
+CAPACITY = 16   # pow2 slot budget on the 8-device mesh (k=2)
+
+
+def _make_world(toy_frame, toy_spec, seed=9):
+    """Fresh residents + newcomer pool + onboarding-capable init (the
+    session mutates init state, so sharing across tests would couple
+    them)."""
+    shards = shard_dataframe(toy_frame, N_RES + N_POOL, "iid", seed=seed)
+    residents = [TablePreprocessor(frame=s, **toy_spec)
+                 for s in shards[:N_RES]]
+    pool = [TablePreprocessor(frame=s, **toy_spec) for s in shards[N_RES:]]
+    init = federated_initialize(residents, seed=0, similarity="sketch")
+    return residents, pool, init
+
+
+# -- fault-spec fail-fast -----------------------------------------------------
+
+
+def test_churn_spec_parses():
+    plan = FaultPlan.parse(
+        "join:round=9,count=2;leave:client=1,round=15;"
+        "drift:client=0,round=13,shift=2.0")
+    assert plan.joins == [(9, 2)]
+    assert plan.leaves == [(15, 1)]
+    assert plan.drifts == [(13, 0, 2.0)]
+    assert plan.has_churn()
+    # 0-based edge-clipping contract: earliest scheduled churn round
+    assert plan.next_churn_round(0) == 8
+    assert plan.next_churn_round(9) == 12
+    assert plan.churn_events(8) == [("join", 2)]
+    assert plan.churn_events(14) == [("leave", 1)]
+    assert plan.churn_events(12) == [("drift", 0, 2.0)]
+    assert plan.churn_events(7) == []
+
+
+def test_churn_spec_fail_fast():
+    with pytest.raises(ValueError, match="join needs a round"):
+        FaultPlan.parse("join:count=2")
+    with pytest.raises(ValueError, match="leave"):
+        FaultPlan.parse("leave:round=5")
+    with pytest.raises(ValueError, match="drift"):
+        FaultPlan.parse("drift:client=1")
+
+
+# -- joins: zero recompiles, reproducible cohorts across resume ---------------
+
+
+def _collect_cohorts(trainer, epochs):
+    """fit() while collecting the per-round sampled cohort ids."""
+    rows = []
+
+    def cb(first_round, metrics):
+        if "cohort" in metrics:
+            rows.append(np.asarray(metrics["cohort"]))
+
+    trainer.fit(epochs, health_cb=cb)
+    return np.concatenate(rows, axis=0) if rows else np.zeros((0, 0), int)
+
+
+def test_join_zero_recompile_and_cohort_resume(toy_frame, toy_spec,
+                                               tmp_path):
+    """A join inside capacity is a data re-upload, not a new program; and
+    the key-derived cohort draws after the join replay bit-identically
+    from a checkpoint."""
+    from fed_tgan_tpu.runtime.checkpoint import load_federated, save_federated
+
+    residents, pool, init = _make_world(toy_frame, toy_spec)
+    cfg = dataclasses.replace(CFG, cohort=8)
+    mesh = client_mesh(8)
+    with sanitize(transfer_guard=False) as counter:
+        tr = FederatedTrainer(init, config=cfg, mesh=mesh, seed=3,
+                              capacity=CAPACITY)
+        el = ElasticFederation(tr, OnboardingSession(init), list(residents))
+        tr.fit(2)
+        before = counter.count("epoch_local")
+        el.join(pool)
+        assert tr.n_clients == N_RES + N_POOL
+        cohorts_joined = _collect_cohorts(tr, 2)
+        assert counter.count("epoch_local") == before, \
+            "a join inside capacity must not compile a new epoch program"
+    assert cohorts_joined.shape[0] == 2
+
+    ck = str(tmp_path / "ck")
+    save_federated(tr, ck, run_name="churn")
+    cont = _collect_cohorts(tr, 3)
+
+    restored = load_federated(ck, mesh=mesh)
+    assert restored.n_clients == N_RES + N_POOL
+    resumed = _collect_cohorts(restored, 3)
+    np.testing.assert_array_equal(cont, resumed)
+    for a, b in zip(jax.tree.leaves(tr.models),
+                    jax.tree.leaves(restored.models)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- departures: survivor renormalization -------------------------------------
+
+
+def test_departure_renormalizes_survivors(toy_frame, toy_spec, tmp_path):
+    from fed_tgan_tpu.runtime.checkpoint import load_federated, save_federated
+
+    residents, _, init = _make_world(toy_frame, toy_spec)
+    tr = FederatedTrainer(init, config=CFG, mesh=client_mesh(8), seed=3,
+                          capacity=CAPACITY, min_clients=2)
+    el = ElasticFederation(tr, OnboardingSession(init), list(residents))
+    w_before = np.asarray(tr.weights, dtype=np.float64).copy()
+    el.leave(4, "test departure")
+    w_after = np.asarray(tr.weights, dtype=np.float64)
+    assert w_after[4] == 0.0
+    assert 4 in tr.dropped_clients
+    assert w_after[:N_RES].sum() == pytest.approx(1.0, abs=1e-5)
+    # survivors keep their RELATIVE similarity standing (pure rescale)
+    survivors = [i for i in range(N_RES) if i != 4]
+    expect = w_before[survivors] / w_before[survivors].sum()
+    np.testing.assert_allclose(w_after[survivors], expect, rtol=1e-5)
+    # padded capacity slots never carry weight
+    assert w_after[N_RES:].sum() == 0.0
+    # a checkpoint round-trip (the watchdog rollback path) must NOT
+    # resurrect the departed client or undo the renormalization
+    tr._strikes[7] = 2
+    ck = str(tmp_path / "ck")
+    save_federated(tr, ck, run_name="churn")
+    restored = load_federated(ck, mesh=client_mesh(8))
+    assert restored.dropped_clients == {4}
+    assert int(restored.steps[4]) == 0
+    np.testing.assert_allclose(np.asarray(restored.weights), w_after,
+                               rtol=1e-6)
+    assert int(restored._strikes[7]) == 2
+
+
+# -- drift: detect, refit, re-weight within one window ------------------------
+
+
+def test_drift_detected_and_reweighted_within_one_window(toy_frame,
+                                                         toy_spec,
+                                                         tmp_path):
+    residents, _, init = _make_world(toy_frame, toy_spec)
+    journal = RunJournal(str(tmp_path / "run.jsonl"), run_id="churn-test")
+    prev = set_journal(journal)
+    try:
+        tr = FederatedTrainer(init, config=CFG, mesh=client_mesh(8),
+                              seed=3, capacity=CAPACITY)
+        wd = TrainingWatchdog(WatchdogConfig(drift_patience=2))
+        el = ElasticFederation(tr, OnboardingSession(init), list(residents),
+                               watchdog=wd,
+                               config=DriftConfig(detect_every=1))
+        # settle a baseline window, then silently swap client 2's shard
+        rec0 = el.detect(0)
+        assert rec0["alarms"] == 0
+        w_before = np.asarray(tr.weights, dtype=np.float64).copy()
+        el.apply_drift(2, shift=2.5, seed=11)
+        rec1 = el.detect(1)
+        assert 2 in rec1["drifted"], \
+            "the window after a silent shard swap must alarm"
+        # online refit + similarity re-weighting inside the SAME window
+        assert rec1["recompute_lag_rounds"] == 0
+        w_after = np.asarray(tr.weights, dtype=np.float64)
+        assert w_after[:N_RES].sum() == pytest.approx(1.0, abs=1e-5)
+        assert abs(w_after[2] - w_before[2]) > 1e-9, \
+            "drifted client's similarity weight must be recomputed"
+        # the refit absorbed the shift: the NEXT window is quiet again
+        rec2 = el.detect(2)
+        assert rec2["alarms"] == 0
+        # ... and a quiet window clears the sustained-drift streak
+        assert wd._drift_streaks == {}
+    finally:
+        set_journal(prev)
+        journal.close()
+    types = [e["type"] for e in read_journal(journal.path)]
+    assert "drift_alarm" in types
+    assert types.count("drift_window") == 3
+
+
+def test_membership_change_suppresses_wd_criterion(toy_frame, toy_spec):
+    """A departure moves the pooled WD reference under EVERY survivor;
+    the next window must not read that as everyone drifting (the absolute
+    JSD criterion stays armed)."""
+    residents, _, init = _make_world(toy_frame, toy_spec)
+    tr = FederatedTrainer(init, config=CFG, mesh=client_mesh(8), seed=3,
+                          capacity=CAPACITY, min_clients=2)
+    el = ElasticFederation(tr, OnboardingSession(init), list(residents),
+                           config=DriftConfig(detect_every=1))
+    el.detect(0)
+    el.leave(0, "pool shift")
+    rec = el.detect(1)
+    assert rec["wd_suppressed"] is True
+    assert rec["alarms"] == 0, \
+        "a departure alone must not alarm the survivors"
+    # baselines re-anchored: the window after is fully armed and quiet
+    rec2 = el.detect(2)
+    assert "wd_suppressed" not in {
+        k for k, v in rec2.items() if v is not None}
+    assert rec2["alarms"] == 0
+
+
+# -- journal -> report / slo narration ----------------------------------------
+
+
+def test_churn_events_fold_into_report_and_slo(toy_frame, toy_spec,
+                                               tmp_path):
+    from fed_tgan_tpu.obs.report import render_text, summarize_many
+    from fed_tgan_tpu.obs.slo import journal_figures
+
+    residents, pool, init = _make_world(toy_frame, toy_spec)
+    jpath = str(tmp_path / "run.jsonl")
+    journal = RunJournal(jpath, run_id="churn-narration")
+    prev = set_journal(journal)
+    try:
+        tr = FederatedTrainer(init, config=CFG, mesh=client_mesh(8),
+                              seed=3, capacity=CAPACITY, min_clients=2)
+        el = ElasticFederation(tr, OnboardingSession(init), list(residents),
+                               config=DriftConfig(detect_every=1))
+        el.join(pool)
+        el.leave(3, "narrated departure")
+        el.detect(0)
+        el.apply_drift(1, shift=2.5, seed=5)
+        el.detect(1)
+    finally:
+        set_journal(prev)
+        journal.close()
+
+    events = list(read_journal(jpath))
+    types = [e["type"] for e in events]
+    assert types.count("client_joined") == N_POOL
+    assert "client_left" in types
+    assert "drift_alarm" in types
+
+    # obs slo: journal folds to gateable churn/drift figures
+    figs = journal_figures(events)
+    assert figs["churn/joins_total"] == N_POOL
+    assert figs["churn/join_repacks"] == 0.0
+    assert figs["churn/leaves_total"] == 1
+    assert figs["drift/alarms_total"] >= 1
+    assert figs["drift/recompute_lag_rounds"] == 0.0
+
+    # obs report: the clients section narrates membership
+    summary = summarize_many([jpath])
+    clients = summary["clients"]
+    assert clients["membership"]["joins"] == N_POOL
+    assert clients["membership"]["leaves"] == 1
+    assert clients["membership"]["drift_alarms"] >= 1
+    text = render_text(summary)
+    assert "membership:" in text
+    assert "drift alarm" in text
+
+
+def test_drift_trajectory_passes_budget_gate(toy_frame, toy_spec,
+                                             tmp_path):
+    """The drift trajectory artifact (journal event stream) must pass the
+    drift-*/churn-* rules in obs/budgets.json via `obs slo` — the same
+    gate the churn soak runs under."""
+    from fed_tgan_tpu.obs.slo import check_slo, default_budgets_path
+
+    residents, pool, init = _make_world(toy_frame, toy_spec)
+    jpath = str(tmp_path / "run.jsonl")
+    journal = RunJournal(jpath, run_id="churn-gate")
+    prev = set_journal(journal)
+    try:
+        tr = FederatedTrainer(init, config=CFG, mesh=client_mesh(8),
+                              seed=3, capacity=CAPACITY, min_clients=2)
+        el = ElasticFederation(tr, OnboardingSession(init), list(residents),
+                               config=DriftConfig(detect_every=1))
+        el.join(pool)
+        el.detect(0)
+        el.apply_drift(0, shift=2.5, seed=3)
+        el.detect(1)
+    finally:
+        set_journal(prev)
+        journal.close()
+
+    traj = str(tmp_path / "trajectory.jsonl")
+    kinds = ("drift_window", "drift_alarm", "client_joined", "client_left")
+    with open(traj, "w") as fh:
+        for ev in read_journal(jpath):
+            if ev.get("type") in kinds:
+                fh.write(json.dumps(ev, default=str) + "\n")
+    code, lines = check_slo(traj, default_budgets_path())
+    assert code == 0, "\n".join(lines)
