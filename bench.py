@@ -52,6 +52,49 @@ def _note_probe(ok: bool, reason: str) -> None:
     })
 
 
+# Top-level backend/platform provenance stamped on every emitted record
+# (normal, wedged-mid-run, wedged-fast-fail) — `obs slo` budgets select on
+# these via select.backend, so CPU-seeded budgets never misfire on a future
+# *_tpu artifact landing next to its CPU twin.  Updated once in main()
+# after platform selection; the conservative default covers records emitted
+# before that point.
+RECORD_FIELDS: dict = {"backend": "cpu", "platform": "cpu"}
+
+
+def _backend_arg(value: str):
+    """argparse ``type=`` for --backend, shared grammar with the CLI
+    (cpu/tpu/gpu/plugin:<name> via the runtime/backend.py seam)."""
+    from fed_tgan_tpu.runtime.backend import parse_backend
+
+    try:
+        return parse_backend(value)
+    except ValueError as exc:
+        raise argparse.ArgumentTypeError(str(exc))
+
+
+def _backend_record_fields(backend_spec, tag: str) -> dict:
+    """backend/platform fields for this run's records.
+
+    A cpu pin or fallback is labeled cpu regardless of what was requested
+    (the tag already narrates the fallback); an explicit accelerator spec
+    reports itself plus the platform jax actually initialized; auto mode
+    reports the live platform, or cpu when no backend ever initializes in
+    this process (the gloo-CPU multihost parent only forks ranks).
+    """
+    from fed_tgan_tpu.runtime.backend import backend_initialized, get_backend
+
+    if tag in ("(cpu)", "(cpu-fallback)"):
+        return {"backend": "cpu", "platform": "cpu"}
+    if backend_spec:
+        return get_backend(backend_spec).record_fields()
+    if backend_initialized():
+        import jax
+
+        plat = jax.default_backend()
+        return {"backend": plat, "platform": plat}
+    return {"backend": "cpu", "platform": "cpu"}
+
+
 def _ensure_responsive_backend() -> str:
     """Probe the accelerator (shared helper); fall back to CPU if wedged.
 
@@ -294,6 +337,7 @@ def _arm_run_deadline(workload: str, tag: str, epochs: int = 500,
                     "mid-measurement; no perf claim",
             "vs_baseline": 0,
             "probe_history": PROBE_HISTORY,
+            **RECORD_FIELDS,
         }
         # the mid-run wedge is the main case the prior-capture evidence
         # exists for (BENCH_r02 lost the round's number exactly this way)
@@ -2225,12 +2269,17 @@ def main() -> int:
                          "Perfetto), metrics.prom (metrics registry, "
                          "Prometheus text).  Pass an empty string to "
                          "disable")
-    ap.add_argument("--backend", choices=["cpu"], default=None,
-                    help="cpu = run this bench explicitly on the cpu "
-                         "platform with no accelerator probe (for "
-                         "comparators and smoke runs; the metric is tagged "
-                         "'(cpu)', distinct from '(cpu-fallback)').  "
-                         "In-process config pin, same as the CLI flag")
+    ap.add_argument("--backend", type=_backend_arg, default=None,
+                    metavar="{cpu,tpu,gpu,plugin:<name>}",
+                    help="execution platform (runtime/backend.py seam, "
+                         "same grammar as the CLI flag): cpu = run this "
+                         "bench explicitly on the cpu platform with no "
+                         "accelerator probe (for comparators and smoke "
+                         "runs; the metric is tagged '(cpu)', distinct "
+                         "from '(cpu-fallback)'); plugin:<name> registers "
+                         "the PJRT plugin (FED_TGAN_PJRT_<NAME>_PATH) "
+                         "before probing.  Default: probe the accelerator, "
+                         "fall back to cpu")
     ap.add_argument("--bgm-backend", choices=["sklearn", "jax"],
                     default=None,
                     help="init-time GMM fitting: jax (default) = the "
@@ -2329,8 +2378,15 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
         tag = "(cpu)"
     else:
+        if args.backend and args.backend.startswith("plugin:"):
+            # fail fast (PluginRegistrationError names the plugin and the
+            # env var) before any probe subprocess is spent
+            from fed_tgan_tpu.runtime.backend import get_backend
+
+            get_backend(args.backend).provision()
         tag = "" if args.workload == "multihost" \
             else _ensure_responsive_backend()
+    RECORD_FIELDS.update(_backend_record_fields(args.backend, tag))
     # persistent compile cache: repeat bench runs (driver runs one per
     # round) skip the one-time XLA compiles entirely.  Machine-scoped — a
     # cache built on another box poisons lookups (see runtime/compile_cache)
@@ -2380,6 +2436,7 @@ def main() -> int:
                     "no perf claim",
             "vs_baseline": 0,
             "probe_history": PROBE_HISTORY,
+            **RECORD_FIELDS,
         }
         _attach_tpu_evidence(rec, "(wedged-fast-fail)")
         print(json.dumps(rec))
@@ -2388,6 +2445,7 @@ def main() -> int:
     if bgm != "sklearn":
         out["metric"] += f"({bgm}-bgm)"
     out["metric"] += tag
+    out.update(RECORD_FIELDS)
     if tag == "(cpu-fallback)":
         # spread-probe policy, second half: the tunnel may have healed
         # while the fallback ran — re-probe and re-run on the chip, so the

@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--contracts-dir", type=Path, default=None,
                     help="contract JSON directory (default: the checked-in "
                          "analysis/contracts/)")
+    ap.add_argument("--backend", default=None,
+                    metavar="{cpu,tpu,gpu,plugin:<name>}",
+                    help="with --contracts: check/record against that "
+                         "backend's contract directory (cpu = the "
+                         "checked-in analysis/contracts/, others get a "
+                         "sibling subdirectory, e.g. analysis/contracts/"
+                         "tpu/); see runtime/backend.py")
     return ap
 
 
@@ -70,11 +77,21 @@ def main(argv=None) -> int:
         # must keep its millisecond no-JAX startup
         from fed_tgan_tpu.analysis.contracts.check import run_contracts
 
+        contracts_dir = args.contracts_dir
+        if contracts_dir is None and args.backend is not None:
+            from fed_tgan_tpu.runtime.backend import contracts_dir_for
+
+            try:
+                contracts_dir = contracts_dir_for(args.backend)
+            except ValueError as exc:
+                print(f"contracts: {exc}", file=sys.stderr)
+                return 2
+
         return run_contracts(
             update=args.contracts_update,
             explain=args.explain,
             fmt=args.format,
-            contracts_dir=args.contracts_dir,
+            contracts_dir=contracts_dir,
         )
 
     rules = None

@@ -140,6 +140,9 @@ def save_contracts(current: Dict[str, Dict[str, Fingerprint]],
             "programs": entries,
         }
         path = _family_path(family, contracts_dir)
+        # per-backend contract sets live in subdirectories of the default
+        # dir (runtime.backend.contracts_dir_for); create on first record
+        path.parent.mkdir(parents=True, exist_ok=True)
         path.write_text(json.dumps(payload, indent=2, sort_keys=True)
                         + "\n")
         paths.append(path)

@@ -122,8 +122,15 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["fedavg", "mdgan", "standalone"],
                    help="fedavg = Fed-TGAN weight averaging; mdgan = GDTS "
                         "split-model (shared generator, local discriminators)")
-    p.add_argument("--backend", type=str, default=None, choices=[None, "tpu", "cpu"],
-                   help="cpu = virtual-device mesh (see --n-virtual-devices)")
+    p.add_argument("--backend", type=_backend_arg, default=None,
+                   metavar="{cpu,tpu,gpu,plugin:<name>}",
+                   help="execution platform (runtime/backend.py seam): "
+                        "cpu = virtual-device mesh (see "
+                        "--n-virtual-devices); tpu/gpu = native PJRT "
+                        "discovery; plugin:<name> = out-of-tree PJRT "
+                        "plugin (shared library from "
+                        "FED_TGAN_PJRT_<NAME>_PATH).  Default: probe the "
+                        "accelerator, fall back to cpu")
     p.add_argument("--bgm-backend", type=str, default="jax",
                    choices=["sklearn", "jax"],
                    help="per-column Bayesian-GMM fitter for init: jax = one "
@@ -323,6 +330,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--init-only", action="store_true",
                    help="multihost mode: run only the federated init "
                         "protocol, skip joining the training mesh")
+    p.add_argument("--params-out", type=str, default=None, metavar="DIR",
+                   help="multihost participant ranks: pickle the final "
+                        "aggregated generator params to "
+                        "DIR/params_rank<r>.pkl (the pod launcher's "
+                        "bit-identity evidence)")
     return p
 
 
@@ -400,12 +412,15 @@ def _run_multihost_init(args) -> int:
             return rc
     if train_after:
         _enable_compile_cache()
-
-    def join_mesh(rank: int) -> None:
+        # Join the jax.distributed mesh BEFORE the init protocol: the
+        # protocol's BGM fits run on jax, and jax.distributed.initialize
+        # refuses to start once any computation has touched the backends.
+        # The coordinator binds port+1 (multihost.JAX_PORT_OFFSET), so the
+        # transport rendezvous on `port` below is unaffected.
         from fed_tgan_tpu.parallel.multihost import initialize_multihost
 
         initialize_multihost(
-            args.ip, port, args.world_size, rank,
+            args.ip, port, args.world_size, args.rank,
             backend=args.backend, n_local_devices=1,
         )
 
@@ -452,7 +467,6 @@ def _run_multihost_init(args) -> int:
             if train_after:
                 from fed_tgan_tpu.train.multihost import server_train
 
-                join_mesh(0)
                 t_train = time.time()
                 books = server_train(
                     t, out, make_run(), name,
@@ -488,7 +502,6 @@ def _run_multihost_init(args) -> int:
                 from fed_tgan_tpu.train.multihost import client_train
                 from fed_tgan_tpu.train.steps import TrainConfig
 
-                join_mesh(args.rank)
                 cfg = TrainConfig(
                     batch_size=args.batch_size,
                     embedding_dim=args.embedding_dim,
@@ -506,7 +519,16 @@ def _run_multihost_init(args) -> int:
                     trim_ratio=args.trim_ratio,
                     precision=args.precision,
                 )
-                client_train(t, out, cfg, make_run())
+                res = client_train(t, out, cfg, make_run())
+                if args.params_out:
+                    os.makedirs(args.params_out, exist_ok=True)
+                    ppath = os.path.join(
+                        args.params_out, f"params_rank{args.rank}.pkl")
+                    with open(ppath, "wb") as f:
+                        # host numpy tree (local_shard materialized it);
+                        # post-psum params are replicated, so any rank's
+                        # copy is the federation's final generator
+                        pickle.dump(res["params_g"], f)
                 print(f"rank {args.rank} training complete")
     return 0
 
@@ -540,6 +562,17 @@ def _parse_date_formats(items) -> dict:
     return out
 
 
+def _backend_arg(value: str) -> str:
+    """argparse ``type=`` for --backend: canonicalize via the runtime seam
+    (cpu/tpu/gpu/plugin:<name>) with a one-line usage error otherwise."""
+    from fed_tgan_tpu.runtime.backend import parse_backend
+
+    try:
+        return parse_backend(value)
+    except ValueError as exc:
+        raise argparse.ArgumentTypeError(str(exc))
+
+
 def _cpu_pinned() -> bool:
     from fed_tgan_tpu.parallel.mesh import cpu_pinned
 
@@ -553,8 +586,9 @@ def _select_backend(args) -> int:
     nonzero to abort.  ``--backend cpu`` provisions the virtual mesh;
     otherwise an accelerator that hangs ``jax.devices()`` (a wedged tunnel
     does, indefinitely) is detected with a subprocess probe: auto mode falls
-    back to a virtual CPU mesh with a warning, an explicit ``--backend tpu``
-    aborts with a clear message instead."""
+    back to a virtual CPU mesh with a warning, an explicit accelerator
+    ``--backend`` (tpu/gpu/plugin:<name>) aborts with a clear message
+    instead."""
     rc = _pick_platform(args)
     if rc == 0:
         _enable_compile_cache()
@@ -574,15 +608,38 @@ def _pick_platform(args, cpu_fallback: bool = True, who: str = "") -> int:
         touch_backend_with_watchdog,
     )
 
+    # an explicitly requested accelerator (tpu/gpu/plugin:<name>) never
+    # silently falls back to cpu — same policy the old tpu-only flag had
+    explicit_accel = args.backend is not None and args.backend != "cpu"
     if args.backend == "cpu":
         provision_virtual_cpu(args.n_virtual_devices)
         return 0
+    if args.backend is not None and args.backend.startswith("plugin:"):
+        from fed_tgan_tpu.runtime.backend import (
+            PluginRegistrationError,
+            get_backend,
+        )
+
+        try:
+            get_backend(args.backend).provision(args.n_virtual_devices)
+        except PluginRegistrationError as exc:
+            print(f"{who}{exc}")
+            return 3
+        # registration only loads the library path into jax's plugin
+        # registry; the first device touch is where a broken plugin hangs
+        # or crashes, so guard it like any accelerator
+        ok, reason = touch_backend_with_watchdog(timeout_s=180.0, who=who)
+        if ok:
+            return 0
+        print(f"{who}{args.backend} backend unusable ({reason}); aborting")
+        return 3
     if _cpu_pinned():
-        if args.backend == "tpu":
+        if explicit_accel:
             print(
-                f"{who}--backend tpu requested but this process is pinned "
-                "to the cpu platform (jax_platforms config or JAX_PLATFORMS "
-                "env); unset the pin or drop --backend tpu"
+                f"{who}--backend {args.backend} requested but this process "
+                "is pinned to the cpu platform (jax_platforms config or "
+                "JAX_PLATFORMS env); unset the pin or drop "
+                f"--backend {args.backend}"
             )
             return 2
         return 0  # this process is already CPU-only: no accelerator to probe
@@ -598,7 +655,7 @@ def _pick_platform(args, cpu_fallback: bool = True, who: str = "") -> int:
         ok, reason = touch_backend_with_watchdog(timeout_s=180.0, who=who)
         if ok:
             return 0
-    if args.backend == "tpu" or not cpu_fallback:
+    if explicit_accel or not cpu_fallback:
         hint = ("fix the accelerator or relaunch every rank with "
                 "--backend cpu" if not cpu_fallback
                 else "retry later or use --backend cpu")

@@ -1312,6 +1312,113 @@ def check_elastic_federation(timeout: int = 420) -> bool:
                  "alarmed and refit within one window")
 
 
+def check_backend_seam(timeout: int = 300) -> bool:
+    """The ``runtime/backend.py`` seam: plugin specs fail fast with a
+    named error before any jax import, and the cpu ``Backend`` provisions
+    the same 8-device platform the pre-seam mesh path did — proven by
+    lowering one contracted family through ``Backend.provision()`` and
+    comparing the fingerprints against the checked-in contract JSON."""
+    import json
+    import subprocess
+
+    from fed_tgan_tpu.runtime.backend import (
+        PluginRegistrationError,
+        get_backend,
+        plugin_env_var,
+    )
+
+    var = plugin_env_var("doesnotexist")
+    try:
+        get_backend("plugin:doesnotexist").provision()
+        return _line(False, "backend-seam",
+                     "plugin:doesnotexist provisioned with no PJRT library "
+                     "-- expected PluginRegistrationError")
+    except PluginRegistrationError as exc:
+        if var not in str(exc):
+            return _line(False, "backend-seam",
+                         f"plugin error does not name {var}: {exc}")
+
+    code = (
+        "import json\n"
+        "from fed_tgan_tpu.runtime.backend import get_backend\n"
+        "get_backend('cpu').provision(8)\n"
+        "from fed_tgan_tpu.analysis.contracts.check import load_contracts\n"
+        "from fed_tgan_tpu.analysis.contracts.harness import (\n"
+        "    ENTRYPOINT_FAMILIES, lower_fingerprints)\n"
+        "fam = 'parallel_fedavg'\n"
+        "cur = lower_fingerprints({fam: ENTRYPOINT_FAMILIES[fam]})\n"
+        "stored = load_contracts([fam])[fam]['programs']\n"
+        "bad = []\n"
+        "for name, fp in cur[fam].items():\n"
+        "    want = {k: v for k, v in stored.get(name, {}).items()\n"
+        "            if k != 'require'}\n"
+        "    if fp.to_dict() != want:\n"
+        "        bad.append(name)\n"
+        "print(json.dumps({'programs': len(cur[fam]), 'bad': bad}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "backend-seam", f"timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return _line(False, "backend-seam",
+                     " | ".join(tail) or "seam lowering failed")
+    try:
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        return _line(False, "backend-seam", f"unparseable result: {exc!r}")
+    if res.get("bad"):
+        return _line(False, "backend-seam",
+                     "cpu Backend lowering diverged from the checked-in "
+                     f"contracts: {', '.join(res['bad'])}")
+    return _line(True, "backend-seam",
+                 f"plugin fail-fast names {var}; cpu Backend lowered "
+                 f"{res.get('programs')} contracted programs byte-identical")
+
+
+def check_launch_pod(timeout: int = 60) -> bool:
+    """``scripts/launch_pod.py --dry-run`` prints the full rank/port/env
+    plan from a jax-free parent — planning a pod must never cost a
+    backend init (or an import of the package) in the supervisor."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "launch_pod.py")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--processes", "3", "--dry-run"],
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=repo)
+    except subprocess.TimeoutExpired:
+        return _line(False, "launch-pod",
+                     f"--dry-run timed out after {timeout}s")
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-2:]
+        return _line(False, "launch-pod",
+                     " | ".join(tail) or "--dry-run failed")
+    lines = proc.stdout.splitlines()
+    ranks = [ln for ln in lines if ln.startswith("rank ")]
+    if len(ranks) != 3:
+        return _line(False, "launch-pod",
+                     f"expected 3 rank plan lines, got {len(ranks)}")
+    if "parent_jax_imported=False" not in proc.stdout:
+        return _line(False, "launch-pod",
+                     "the planning parent imported jax "
+                     "(parent_jax_imported=False missing)")
+    roles = [ln.split("role=")[1].split()[0] for ln in ranks]
+    if roles != ["coordinator", "participant", "participant"]:
+        return _line(False, "launch-pod", f"unexpected roles {roles}")
+    return _line(True, "launch-pod",
+                 "3-process plan (1 coordinator + 2 participants) printed "
+                 "from a jax-free parent")
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1371,6 +1478,8 @@ def main(argv=None) -> int:
         check_front_door(),
         check_quality_canary(),
         check_elastic_federation(),
+        check_backend_seam(),
+        check_launch_pod(),
     ]
     bad = checks.count(False)
     print(f"{len(checks) - bad}/{len(checks)} checks passed")

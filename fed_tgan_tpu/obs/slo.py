@@ -16,7 +16,8 @@ Budget file shape (``obs/budgets.json`` is the packaged default)::
 
     {"schema": 1, "budgets": [
         {"name": "serving-p99",              # unique label for output
-         "select": {"metric_prefix": "bench_serving("},  # optional
+         "select": {"metric_prefix": "bench_serving(",  # optional
+                    "backend": "cpu"},       # optional backend gate
          "metric": "p99_ms",                 # dotted path / figure key
          "max": 35.0,                        # or "min": <floor>
          "stale_frac": 0.4},                 # optional staleness knobs
@@ -24,8 +25,12 @@ Budget file shape (``obs/budgets.json`` is the packaged default)::
 
 For bench inputs ``metric`` is a dotted path into the record
 (``per_tenant.t0.p99_ms``); ``select.metric_prefix`` restricts the rule
-to records whose ``metric`` string starts with the prefix.  For journal
-inputs the events are first folded into flat figures:
+to records whose ``metric`` string starts with the prefix, and
+``select.backend`` to records whose top-level ``backend`` field matches
+(records without the field count as ``cpu`` — every pre-seam artifact is
+a CPU number), so CPU-seeded budgets never misfire on ``*_tpu``
+artifacts.  For journal inputs the events are first folded into flat
+figures:
 
 - ``program_cost``  -> ``program/<name>/flops|bytes_accessed|peak_bytes``
   (last event per program wins)
@@ -294,9 +299,17 @@ def check_slo(input_path: str, budgets_path: str) -> Tuple[int, List[str]]:
         for rule in rules:
             select = rule.get("select") or {}
             prefix = select.get("metric_prefix", "")
+            want_backend = select.get("backend")
             for rec in records:
                 metric = str(rec.get("metric", ""))
                 if prefix and not metric.startswith(prefix):
+                    continue
+                # records predating the backend field are CPU-era by
+                # construction (every headline to date is CPU-tagged), so
+                # a missing field matches "cpu" and checked-in BENCH_r*
+                # artifacts keep passing re-tagged budgets
+                if want_backend and str(
+                        rec.get("backend", "cpu")) != want_backend:
                     continue
                 value = _dotted(rec, rule["metric"])
                 if not isinstance(value, (int, float)):

@@ -9,18 +9,49 @@ there is no separate server rank — aggregation is a collective.
 Multi-host: initialize ``jax.distributed`` before building the mesh and the
 same code spans hosts, with XLA routing the FedAvg psum over ICI within a
 slice and DCN across slices.
+
+Platform selection, provisioning, probing and mesh/topology construction
+now live in ``runtime/backend.py`` (the portable backend seam); the
+historical entry points below are re-exports kept so existing imports —
+and the test monkeypatch seams on this module — keep working.  What stays
+native here is the shard_map-adjacent collective surface.
 """
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax
+
+from fed_tgan_tpu.runtime.backend import (  # noqa: F401  (re-exported shims)
+    CLIENTS_AXIS,
+    _probe_stamp_path,
+    arm_watchdog,
+    backend_initialized,
+    client_mesh,
+    cpu_pinned,
+    host_axis_groups,
+    probe_backend_responsive,
+    provision_virtual_cpu,
+)
+from fed_tgan_tpu.runtime.backend import (
+    touch_backend_with_watchdog as _touch_backend_impl,
+)
 from jax.sharding import Mesh
 
-from fed_tgan_tpu.obs.journal import emit as _emit_event
 
-CLIENTS_AXIS = "clients"
+def touch_backend_with_watchdog(
+    timeout_s: float = 180.0,
+    who: str = "",
+    _touch=None,
+    _abort=None,
+) -> tuple[bool, str]:
+    """Shim over ``runtime.backend.touch_backend_with_watchdog`` that reads
+    the already-initialized early exit through THIS module's
+    ``backend_initialized`` global, so tests (and callers) that patch the
+    historical ``parallel.mesh`` seam keep governing the real behavior."""
+    return _touch_backend_impl(
+        timeout_s=timeout_s, who=who, _touch=_touch, _abort=_abort,
+        _initialized=lambda: backend_initialized(),
+    )
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -50,300 +81,6 @@ def pcast_varying(x, axes):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axes, to="varying")
     return x
-
-
-def cpu_pinned() -> bool:
-    """Whether this process can only ever see the cpu platform.  The config
-    value only reflects ``config.update``; an env-var pin is read by jax at
-    backend-init time, so consult both.  NOTE: on hosts whose site hook
-    pre-imports jax against an accelerator plugin, a fresh subprocess may
-    ignore an env-var cpu pin — in-process ``jax.config.update`` is the
-    reliable route (provision_virtual_cpu does this)."""
-    import os
-
-    platforms = getattr(jax.config, "jax_platforms", None) or os.environ.get(
-        "JAX_PLATFORMS"
-    )
-    return bool(platforms) and set(str(platforms).split(",")) <= {"cpu"}
-
-
-def backend_initialized() -> bool:
-    """True once any JAX backend client exists in this process."""
-    try:
-        from jax._src import xla_bridge
-
-        return bool(xla_bridge._backends)
-    except Exception:
-        return False  # private API moved: assume uninitialized
-
-
-def probe_backend_responsive(
-    timeout_s: int = 15,
-    attempts: int = 1,
-    backoff_s: float = 60.0,
-    log=None,
-    ignore_cache: bool = False,
-) -> tuple[bool, str]:
-    """Whether ``jax.devices()`` completes in a fresh interpreter.
-
-    A wedged accelerator tunnel hangs ``jax.devices()`` indefinitely (seen
-    on the tunneled TPU transport under sustained load); probing in a
-    SUBPROCESS with a timeout lets callers fall back to a CPU mesh instead
-    of hanging with it.  Only meaningful before this process initializes a
-    backend.
-
-    The deadline is a hard ~15 s by default: a healthy backend answers in
-    low single-digit seconds, and BENCH_r05 measured a wedged tunnel
-    holding the old 120–300 s deadlines for their full duration on every
-    attempt — CPU failover should cost seconds, not minutes.
-
-    Returns ``(ok, reason)`` — ``reason`` distinguishes a hang from a fast
-    crash and carries the child's stderr tail so misconfigurations (e.g. a
-    plugin version mismatch) aren't misreported as "unresponsive".
-
-    ``attempts`` > 1 retries a failed probe after ``backoff_s`` seconds —
-    for callers (the benchmark) whose entire purpose is the accelerator
-    number, one transient wedge or a probe racing another process holding
-    the chip should not flip the run to CPU permanently.  ``log`` (callable
-    taking a string) narrates each failed attempt so a fallback is
-    self-explaining.
-
-    A successful probe is cached on disk for ``cache_s`` seconds (keyed by
-    platform selection and uid) so bursts of CLI runs on a healthy machine
-    don't pay the backend double-initialization.  The cache is a liveness
-    tradeoff — a wedge arriving inside the window hangs the NEXT run like
-    an unprobed one would (the probe is inherently a point-in-time check:
-    even an uncached probe races a wedge arriving right after it); callers
-    close that hole with ``touch_backend_with_watchdog``.  The window is
-    kept short for that reason; failures are never cached.
-    """
-    import os
-    import subprocess
-    import sys
-    import time
-
-    cache_s = 300
-    stamp = _probe_stamp_path()
-    if not ignore_cache:
-        # ``ignore_cache``: callers whose whole point is CURRENT liveness
-        # (doctor --wait-healthy gating a relaunch) must not be vouched for
-        # by a stamp that may predate a fresh wedge
-        try:
-            st = os.lstat(stamp)  # lstat: never trust a symlinked stamp
-            import stat as _stat
-
-            if (_stat.S_ISREG(st.st_mode) and st.st_uid == os.getuid()
-                    and time.time() - st.st_mtime < cache_s):
-                return True, "cached"
-        except OSError:
-            pass
-
-    reason = ""
-    for attempt in range(1, max(1, attempts) + 1):
-        if attempt > 1:
-            if log is not None:
-                log(f"backend probe attempt {attempt - 1}/{attempts} failed "
-                    f"({reason}); retrying in {backoff_s:.0f}s")
-            time.sleep(backoff_s)
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True,
-                text=True,
-                timeout=timeout_s,
-            )
-        except subprocess.TimeoutExpired:
-            reason = (f"jax.devices() did not return within {timeout_s}s "
-                      "(hung backend)")
-            continue
-        if proc.returncode != 0:
-            tail = (proc.stderr or "").strip().splitlines()[-3:]
-            reason = ("backend probe crashed: "
-                      + (" | ".join(tail) or f"rc={proc.returncode}"))
-            continue
-        try:
-            fd = os.open(stamp, os.O_WRONLY | os.O_CREAT | os.O_NOFOLLOW,
-                         0o600)
-            os.utime(fd)
-            os.close(fd)
-        except OSError:
-            pass
-        _emit_event("backend_probe", ok=True, attempts=attempt,
-                    timeout_s=timeout_s)
-        return True, "" if attempt == 1 else f"ok after {attempt} attempts"
-    if attempts > 1:
-        reason += f" (after {attempts} attempts over ~" \
-                  f"{attempts * timeout_s + (attempts - 1) * backoff_s:.0f}s)"
-    _emit_event("backend_probe", ok=False, reason=reason,
-                timeout_s=timeout_s)
-    return False, reason
-
-
-def _probe_stamp_path() -> str:
-    """Path of the positive-probe cache stamp.
-
-    uid in the key + O_NOFOLLOW on create (see caller): on a shared box
-    another user's stale stamp must not vouch for this user's tunnel, nor
-    may a planted symlink at the predictable path redirect the create.
-    """
-    import hashlib
-    import os
-    import sys
-    import tempfile
-
-    key = hashlib.sha256(
-        (os.environ.get("JAX_PLATFORMS", "") + sys.executable
-         + str(os.getuid())).encode()
-    ).hexdigest()[:16]
-    return os.path.join(tempfile.gettempdir(), f".fed_tgan_backend_ok_{key}")
-
-
-def arm_watchdog(timeout_s: float, on_fire, name: str = "watchdog"):
-    """Daemon thread that calls ``on_fire()`` unless cancelled within
-    ``timeout_s``; returns the cancel callable.  Shared core of the
-    backend-touch watchdog and the bench run deadline, so the
-    Event/daemon-thread/force-exit shape cannot drift between them."""
-    import threading
-
-    done = threading.Event()
-
-    def _watch() -> None:
-        if not done.wait(timeout_s):
-            on_fire()
-
-    threading.Thread(target=_watch, daemon=True, name=name).start()
-    return done.set
-
-
-def touch_backend_with_watchdog(
-    timeout_s: float = 180.0,
-    who: str = "",
-    _touch=None,
-    _abort=None,
-) -> tuple[bool, str]:
-    """Initialize the accelerator backend NOW, guarded by a watchdog.
-
-    The probe cache means a run can start inside the positive-cache window
-    of a probe that predates a fresh wedge; that run's first real
-    ``jax.devices()`` then hangs exactly like an unprobed one.  Calling
-    this right after platform selection closes the hole: the touch happens
-    immediately, and a watchdog thread aborts the process with the same
-    diagnosis the probe produces if it doesn't complete in ``timeout_s``.
-
-    A touch that CRASHES instead of hanging (e.g. another process grabbed
-    the chip between probe and touch) returns ``(False, reason)`` — the
-    probe-style contract — so callers route it through their normal
-    fallback/abort policy instead of dying on a raw traceback.  A hang
-    cannot return: the watchdog ``os._exit``\\ s (not ``sys.exit``) because
-    the main thread is stuck inside an uninterruptible C extension call —
-    no Python exception can reach it.  Both failure modes invalidate the
-    positive stamp so the next run re-probes for real.
-    ``_touch``/``_abort`` are test seams.
-    """
-    if backend_initialized():
-        return True, ""
-    import os
-    import sys
-
-    def _drop_stamp() -> None:
-        # invalidate the (now-stale) positive stamp so the NEXT run
-        # re-probes for real and can fall back to CPU gracefully
-        # instead of repeating this failure for the cache window
-        try:
-            os.unlink(_probe_stamp_path())
-        except OSError:
-            pass
-
-    def _fire() -> None:
-        _drop_stamp()
-        print(
-            f"{who}accelerator backend unusable (jax.devices() did not "
-            f"return within {timeout_s:.0f}s after a positive probe — "
-            "the tunnel likely wedged inside the probe-cache window); "
-            "aborting — retry later or use --backend cpu",
-            file=sys.stderr,
-            flush=True,
-        )
-        (_abort or os._exit)(3)
-
-    cancel = arm_watchdog(timeout_s, _fire, name="backend-touch-watchdog")
-    try:
-        (jax.devices if _touch is None else _touch)()
-    except Exception as exc:
-        _drop_stamp()
-        return False, f"backend init crashed after a positive probe: {exc}"
-    finally:
-        cancel()
-    return True, ""
-
-
-def provision_virtual_cpu(n_devices: int) -> None:
-    """Force an ``n_devices`` virtual CPU platform (the tests/CI recipe).
-
-    Must run before any JAX backend initializes.  Sets
-    ``--xla_force_host_platform_device_count`` in XLA_FLAGS — replacing any
-    existing (possibly smaller) value — then overrides the platform through
-    the config API, because this environment pre-imports jax with
-    JAX_PLATFORMS=axon via a site hook, making the env-var route too late.
-    Raises RuntimeError if the devices don't materialize (i.e. a backend was
-    already initialized in this process).
-    """
-    import os
-    import re
-
-    flags = re.sub(
-        r"--xla_force_host_platform_device_count=\d+",
-        "",
-        os.environ.get("XLA_FLAGS", ""),
-    )
-    os.environ["XLA_FLAGS"] = (
-        f"{flags} --xla_force_host_platform_device_count={n_devices}"
-    ).strip()
-    jax.config.update("jax_platforms", "cpu")
-    if len(jax.devices()) < n_devices:
-        raise RuntimeError(
-            f"could not provision {n_devices} virtual CPU devices "
-            f"(got {len(jax.devices())}); was a backend already initialized?"
-        )
-
-
-def client_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """A 1-D mesh over ``n_devices`` (default: all) with axis 'clients'."""
-    if devices is None:
-        devices = jax.devices()
-    if n_devices is not None:
-        if n_devices > len(devices):
-            raise ValueError(
-                f"requested {n_devices} devices, only {len(devices)} available"
-            )
-        devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (CLIENTS_AXIS,))
-
-
-def host_axis_groups(mesh: Mesh):
-    """``axis_index_groups`` pair for a two-tier (intra-host, cross-host)
-    psum over the clients axis, or ``None`` when tiering buys nothing.
-
-    Tier 1 groups the mesh positions living on one host process (reduced
-    over fast intra-host interconnect); tier 2 groups one representative
-    column across hosts, so the cross-host hop moves one partial per host
-    instead of one per device.  Returns ``None`` — callers then emit the
-    plain flat psum, byte-identical to pre-tier programs — when the mesh
-    spans fewer than two processes, hosts hold unequal device counts
-    (grouped psums need rectangular groups), or each host has a single
-    device (tier 1 would be a no-op).
-    """
-    by_proc: dict[int, list[int]] = {}
-    for idx, d in enumerate(mesh.devices.flat):
-        by_proc.setdefault(d.process_index, []).append(idx)
-    groups = [by_proc[p] for p in sorted(by_proc)]
-    if len(groups) < 2:
-        return None
-    width = len(groups[0])
-    if width < 2 or any(len(g) != width for g in groups):
-        return None
-    inter = [[g[j] for g in groups] for j in range(width)]
-    return groups, inter
 
 
 def clients_per_device(n_clients: int, mesh: Mesh) -> int:

@@ -1,0 +1,560 @@
+"""Portable backend runtime: one seam between the program and the platform.
+
+Everything the rest of the codebase needs to know about *where* it runs
+lives here: device discovery, platform selection, virtual-CPU
+provisioning, PJRT plugin registration, mesh construction, the
+``hierarchical_psum`` host-group topology, and the probe/watchdog
+machinery that keeps a wedged accelerator tunnel from hanging a run.
+``parallel/mesh.py`` re-exports the historical entry points as thin
+shims, so existing imports (and test monkeypatch seams) keep working.
+
+Backends are named by a ``--backend`` spec:
+
+- ``cpu``  — the virtual-device host platform (tests/CI recipe; the
+  default everywhere, byte-identical to the pre-seam lowered programs)
+- ``tpu`` / ``gpu`` — native PJRT discovery, probed through a
+  subprocess before first use so a hung tunnel is diagnosed, not hung on
+- ``plugin:<name>`` — an out-of-tree PJRT plugin registered via
+  ``xla_bridge.register_plugin`` + ``jax_platforms`` (SNIPPETS.md [3]);
+  the shared library path comes from ``FED_TGAN_PJRT_<NAME>_PATH`` and a
+  missing plugin fails fast with :class:`PluginRegistrationError`
+  instead of a deep jax traceback.
+
+This module is importable before jax (jax is imported lazily inside
+functions): the pod launcher's ``--dry-run`` parent and the obs tooling
+stay jax-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+
+from fed_tgan_tpu.obs.journal import emit as _emit_event
+
+CLIENTS_AXIS = "clients"
+
+#: closed set of first-class platform names; anything else must be a
+#: ``plugin:<name>`` spec
+KNOWN_PLATFORMS = ("cpu", "tpu", "gpu")
+
+_PLUGIN_PREFIX = "plugin:"
+
+
+class PluginRegistrationError(RuntimeError):
+    """A ``plugin:<name>`` backend could not be registered (missing or
+    unreadable PJRT shared library, bad plugin name).  Named so callers —
+    and the doctor's ``backend-seam`` check — can fail fast with the
+    plugin's identity instead of surfacing a deep jax traceback."""
+
+
+def parse_backend(spec):
+    """Validate a ``--backend`` spec; returns the canonical name.
+
+    Accepts ``cpu``/``tpu``/``gpu``/``plugin:<name>`` (and ``None``,
+    passed through: auto mode — probe the accelerator, fall back to CPU).
+    Raises ``ValueError`` with the accepted grammar otherwise, so argparse
+    ``type=`` callers surface a one-line usage error.
+    """
+    if spec is None:
+        return None
+    name = str(spec).strip()
+    low = name.lower()
+    if low in KNOWN_PLATFORMS:
+        return low
+    if low.startswith(_PLUGIN_PREFIX):
+        plugin = name[len(_PLUGIN_PREFIX):].strip()
+        if plugin and all(c.isalnum() or c in "_-" for c in plugin):
+            return _PLUGIN_PREFIX + plugin
+        raise ValueError(
+            f"bad plugin backend {spec!r}: expected plugin:<name> with an "
+            "alphanumeric/_/- name")
+    raise ValueError(
+        f"unknown backend {spec!r}: expected one of cpu, tpu, gpu, or "
+        "plugin:<name>")
+
+
+def plugin_env_var(plugin: str) -> str:
+    """Env var naming the PJRT shared library for ``plugin:<plugin>``."""
+    return "FED_TGAN_PJRT_%s_PATH" % plugin.upper().replace("-", "_")
+
+
+def register_pjrt_plugin(plugin: str, library_path: str | None = None) -> None:
+    """Register an out-of-tree PJRT plugin and put it on the platform list.
+
+    The SNIPPETS.md [3] pattern: ``xla_bridge.register_plugin(name,
+    library_path=...)`` then ``jax_platforms = "cpu,<name>"`` so the host
+    platform stays available for staging buffers.  Must run before any
+    backend initializes.  A missing/unset library raises
+    :class:`PluginRegistrationError` naming the plugin and the env var —
+    fail fast, before jax is even imported.
+    """
+    env = plugin_env_var(plugin)
+    if library_path is None:
+        library_path = os.environ.get(env, "")
+    if not library_path:
+        raise PluginRegistrationError(
+            f"PJRT plugin '{plugin}' has no shared library configured: "
+            f"set {env}=/path/to/pjrt_plugin_{plugin}.so")
+    if not os.path.exists(library_path):
+        raise PluginRegistrationError(
+            f"PJRT plugin '{plugin}' shared library not found at "
+            f"{library_path} (from {env}); is the plugin built?")
+    from jax._src import xla_bridge as xb
+
+    import jax
+
+    xb.register_plugin(plugin, priority=10, library_path=library_path,
+                       options=None)
+    jax.config.update("jax_platforms", f"cpu,{plugin}")
+    _emit_event("backend_plugin_registered", plugin=plugin,
+                library_path=library_path)
+
+
+def cpu_pinned() -> bool:
+    """Whether this process can only ever see the cpu platform.  The config
+    value only reflects ``config.update``; an env-var pin is read by jax at
+    backend-init time, so consult both.  NOTE: on hosts whose site hook
+    pre-imports jax against an accelerator plugin, a fresh subprocess may
+    ignore an env-var cpu pin — in-process ``jax.config.update`` is the
+    reliable route (provision_virtual_cpu does this)."""
+    import jax
+
+    platforms = getattr(jax.config, "jax_platforms", None) or os.environ.get(
+        "JAX_PLATFORMS"
+    )
+    return bool(platforms) and set(str(platforms).split(",")) <= {"cpu"}
+
+
+def backend_initialized() -> bool:
+    """True once any JAX backend client exists in this process."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False  # private API moved: assume uninitialized
+
+
+def probe_backend_responsive(
+    timeout_s: int = 15,
+    attempts: int = 1,
+    backoff_s: float = 60.0,
+    log=None,
+    ignore_cache: bool = False,
+) -> tuple[bool, str]:
+    """Whether ``jax.devices()`` completes in a fresh interpreter.
+
+    A wedged accelerator tunnel hangs ``jax.devices()`` indefinitely (seen
+    on the tunneled TPU transport under sustained load); probing in a
+    SUBPROCESS with a timeout lets callers fall back to a CPU mesh instead
+    of hanging with it.  Only meaningful before this process initializes a
+    backend.
+
+    The deadline is a hard ~15 s by default: a healthy backend answers in
+    low single-digit seconds, and BENCH_r05 measured a wedged tunnel
+    holding the old 120–300 s deadlines for their full duration on every
+    attempt — CPU failover should cost seconds, not minutes.
+
+    Returns ``(ok, reason)`` — ``reason`` distinguishes a hang from a fast
+    crash and carries the child's stderr tail so misconfigurations (e.g. a
+    plugin version mismatch) aren't misreported as "unresponsive".
+
+    ``attempts`` > 1 retries a failed probe after ``backoff_s`` seconds —
+    for callers (the benchmark) whose entire purpose is the accelerator
+    number, one transient wedge or a probe racing another process holding
+    the chip should not flip the run to CPU permanently.  ``log`` (callable
+    taking a string) narrates each failed attempt so a fallback is
+    self-explaining.
+
+    A successful probe is cached on disk for ``cache_s`` seconds (keyed by
+    platform selection and uid) so bursts of CLI runs on a healthy machine
+    don't pay the backend double-initialization.  The cache is a liveness
+    tradeoff — a wedge arriving inside the window hangs the NEXT run like
+    an unprobed one would (the probe is inherently a point-in-time check:
+    even an uncached probe races a wedge arriving right after it); callers
+    close that hole with ``touch_backend_with_watchdog``.  The window is
+    kept short for that reason; failures are never cached.
+    """
+    import subprocess
+    import sys
+    import time
+
+    cache_s = 300
+    stamp = _probe_stamp_path()
+    if not ignore_cache:
+        # ``ignore_cache``: callers whose whole point is CURRENT liveness
+        # (doctor --wait-healthy gating a relaunch) must not be vouched for
+        # by a stamp that may predate a fresh wedge
+        try:
+            st = os.lstat(stamp)  # lstat: never trust a symlinked stamp
+            import stat as _stat
+
+            if (_stat.S_ISREG(st.st_mode) and st.st_uid == os.getuid()
+                    and time.time() - st.st_mtime < cache_s):
+                return True, "cached"
+        except OSError:
+            pass
+
+    reason = ""
+    for attempt in range(1, max(1, attempts) + 1):
+        if attempt > 1:
+            if log is not None:
+                log(f"backend probe attempt {attempt - 1}/{attempts} failed "
+                    f"({reason}); retrying in {backoff_s:.0f}s")
+            time.sleep(backoff_s)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            reason = (f"jax.devices() did not return within {timeout_s}s "
+                      "(hung backend)")
+            continue
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            reason = ("backend probe crashed: "
+                      + (" | ".join(tail) or f"rc={proc.returncode}"))
+            continue
+        try:
+            fd = os.open(stamp, os.O_WRONLY | os.O_CREAT | os.O_NOFOLLOW,
+                         0o600)
+            os.utime(fd)
+            os.close(fd)
+        except OSError:
+            pass
+        _emit_event("backend_probe", ok=True, attempts=attempt,
+                    timeout_s=timeout_s)
+        return True, "" if attempt == 1 else f"ok after {attempt} attempts"
+    if attempts > 1:
+        reason += f" (after {attempts} attempts over ~" \
+                  f"{attempts * timeout_s + (attempts - 1) * backoff_s:.0f}s)"
+    _emit_event("backend_probe", ok=False, reason=reason,
+                timeout_s=timeout_s)
+    return False, reason
+
+
+def _probe_stamp_path() -> str:
+    """Path of the positive-probe cache stamp.
+
+    uid in the key + O_NOFOLLOW on create (see caller): on a shared box
+    another user's stale stamp must not vouch for this user's tunnel, nor
+    may a planted symlink at the predictable path redirect the create.
+    """
+    import hashlib
+    import sys
+    import tempfile
+
+    key = hashlib.sha256(
+        (os.environ.get("JAX_PLATFORMS", "") + sys.executable
+         + str(os.getuid())).encode()
+    ).hexdigest()[:16]
+    return os.path.join(tempfile.gettempdir(), f".fed_tgan_backend_ok_{key}")
+
+
+def arm_watchdog(timeout_s: float, on_fire, name: str = "watchdog"):
+    """Daemon thread that calls ``on_fire()`` unless cancelled within
+    ``timeout_s``; returns the cancel callable.  Shared core of the
+    backend-touch watchdog and the bench run deadline, so the
+    Event/daemon-thread/force-exit shape cannot drift between them."""
+    import threading
+
+    done = threading.Event()
+
+    def _watch() -> None:
+        if not done.wait(timeout_s):
+            on_fire()
+
+    threading.Thread(target=_watch, daemon=True, name=name).start()
+    return done.set
+
+
+def touch_backend_with_watchdog(
+    timeout_s: float = 180.0,
+    who: str = "",
+    _touch=None,
+    _abort=None,
+    _initialized=None,
+) -> tuple[bool, str]:
+    """Initialize the accelerator backend NOW, guarded by a watchdog.
+
+    The probe cache means a run can start inside the positive-cache window
+    of a probe that predates a fresh wedge; that run's first real
+    ``jax.devices()`` then hangs exactly like an unprobed one.  Calling
+    this right after platform selection closes the hole: the touch happens
+    immediately, and a watchdog thread aborts the process with the same
+    diagnosis the probe produces if it doesn't complete in ``timeout_s``.
+
+    A touch that CRASHES instead of hanging (e.g. another process grabbed
+    the chip between probe and touch) returns ``(False, reason)`` — the
+    probe-style contract — so callers route it through their normal
+    fallback/abort policy instead of dying on a raw traceback.  A hang
+    cannot return: the watchdog ``os._exit``\\ s (not ``sys.exit``) because
+    the main thread is stuck inside an uninterruptible C extension call —
+    no Python exception can reach it.  Both failure modes invalidate the
+    positive stamp so the next run re-probes for real.
+    ``_touch``/``_abort`` are test seams; ``_initialized`` lets the
+    ``parallel/mesh.py`` shim route the already-initialized early exit
+    through its own (monkeypatchable) ``backend_initialized`` global.
+    """
+    if (_initialized or backend_initialized)():
+        return True, ""
+    import sys
+
+    import jax
+
+    def _drop_stamp() -> None:
+        # invalidate the (now-stale) positive stamp so the NEXT run
+        # re-probes for real and can fall back to CPU gracefully
+        # instead of repeating this failure for the cache window
+        try:
+            os.unlink(_probe_stamp_path())
+        except OSError:
+            pass
+
+    def _fire() -> None:
+        _drop_stamp()
+        print(
+            f"{who}accelerator backend unusable (jax.devices() did not "
+            f"return within {timeout_s:.0f}s after a positive probe — "
+            "the tunnel likely wedged inside the probe-cache window); "
+            "aborting — retry later or use --backend cpu",
+            file=sys.stderr,
+            flush=True,
+        )
+        (_abort or os._exit)(3)
+
+    cancel = arm_watchdog(timeout_s, _fire, name="backend-touch-watchdog")
+    try:
+        (jax.devices if _touch is None else _touch)()
+    except Exception as exc:
+        _drop_stamp()
+        return False, f"backend init crashed after a positive probe: {exc}"
+    finally:
+        cancel()
+    return True, ""
+
+
+def provision_virtual_cpu(n_devices: int) -> None:
+    """Force an ``n_devices`` virtual CPU platform (the tests/CI recipe).
+
+    Must run before any JAX backend initializes.  Sets
+    ``--xla_force_host_platform_device_count`` in XLA_FLAGS — replacing any
+    existing (possibly smaller) value — then overrides the platform through
+    the config API, because this environment pre-imports jax with
+    JAX_PLATFORMS=axon via a site hook, making the env-var route too late.
+    Raises RuntimeError if the devices don't materialize (i.e. a backend was
+    already initialized in this process).
+    """
+    import re
+
+    import jax
+
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"could not provision {n_devices} virtual CPU devices "
+            f"(got {len(jax.devices())}); was a backend already initialized?"
+        )
+
+
+def client_mesh(n_devices: int | None = None, devices=None):
+    """A 1-D mesh over ``n_devices`` (default: all) with axis 'clients'."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (CLIENTS_AXIS,))
+
+
+def host_axis_groups(mesh):
+    """``axis_index_groups`` pair for a two-tier (intra-host, cross-host)
+    psum over the clients axis, or ``None`` when tiering buys nothing.
+
+    Tier 1 groups the mesh positions living on one host process (reduced
+    over fast intra-host interconnect); tier 2 groups one representative
+    column across hosts, so the cross-host hop moves one partial per host
+    instead of one per device.  Returns ``None`` — callers then emit the
+    plain flat psum, byte-identical to pre-tier programs — when the mesh
+    spans fewer than two processes, hosts hold unequal device counts
+    (grouped psums need rectangular groups), or each host has a single
+    device (tier 1 would be a no-op).
+    """
+    by_proc: dict[int, list[int]] = {}
+    for idx, d in enumerate(mesh.devices.flat):
+        by_proc.setdefault(d.process_index, []).append(idx)
+    groups = [by_proc[p] for p in sorted(by_proc)]
+    if len(groups) < 2:
+        return None
+    width = len(groups[0])
+    if width < 2 or any(len(g) != width for g in groups):
+        return None
+    inter = [[g[j] for g in groups] for j in range(width)]
+    return groups, inter
+
+
+# --------------------------------------------------------------------------
+# the Backend object: one handle for "which platform, is it alive, and how
+# do I stand a mesh up on it"
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendHealth:
+    """Result of :meth:`Backend.probe` / :meth:`Backend.touch`.
+
+    ``ok`` is the verdict; ``reason`` narrates a failure (or carries the
+    probe's "cached"/"ok after N attempts" provenance on success);
+    ``cached`` flags a positive verdict vouched for by the probe-stamp
+    cache rather than a fresh subprocess run.
+    """
+
+    ok: bool
+    reason: str = ""
+    cached: bool = False
+    backend: str = "cpu"
+
+    def __bool__(self) -> bool:  # allows `if backend.probe():`
+        return self.ok
+
+
+class Backend:
+    """A named execution platform and the policy for standing it up.
+
+    Construction is cheap and jax-free; jax is touched only by
+    :meth:`provision`/:meth:`touch`/:meth:`mesh`.  One instance per spec —
+    use :func:`get_backend`.
+    """
+
+    def __init__(self, name: str):
+        self.name = parse_backend(name) or "cpu"
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def is_cpu(self) -> bool:
+        return self.name == "cpu"
+
+    @property
+    def is_plugin(self) -> bool:
+        return self.name.startswith(_PLUGIN_PREFIX)
+
+    @property
+    def plugin_name(self) -> str | None:
+        return self.name[len(_PLUGIN_PREFIX):] if self.is_plugin else None
+
+    @property
+    def platform(self) -> str:
+        """The jax platform name this backend resolves to ('cpu', 'tpu',
+        'gpu', or the plugin's registered name)."""
+        return self.plugin_name or self.name
+
+    def __repr__(self) -> str:
+        return f"Backend({self.name!r})"
+
+    # -- health ------------------------------------------------------------
+    def probe(self, timeout_s: int = 15, attempts: int = 1,
+              backoff_s: float = 60.0, log=None,
+              ignore_cache: bool = False) -> BackendHealth:
+        """Subprocess-probe the platform (see
+        :func:`probe_backend_responsive`).  The cpu backend is trivially
+        healthy — the host platform cannot wedge — so no subprocess is
+        spent on it."""
+        if self.is_cpu:
+            return BackendHealth(True, "host platform", backend=self.name)
+        ok, reason = probe_backend_responsive(
+            timeout_s=timeout_s, attempts=attempts, backoff_s=backoff_s,
+            log=log, ignore_cache=ignore_cache)
+        return BackendHealth(ok, reason, cached=(reason == "cached"),
+                             backend=self.name)
+
+    def touch(self, timeout_s: float = 180.0, who: str = "") -> BackendHealth:
+        """Initialize the backend now under a watchdog (see
+        :func:`touch_backend_with_watchdog`)."""
+        ok, reason = touch_backend_with_watchdog(timeout_s=timeout_s, who=who)
+        return BackendHealth(ok, reason, backend=self.name)
+
+    # -- provisioning ------------------------------------------------------
+    def provision(self, n_virtual_devices: int = 8) -> None:
+        """Make the platform selectable before jax initializes.
+
+        cpu: force the ``n_virtual_devices`` virtual host mesh (the exact
+        pre-seam ``provision_virtual_cpu`` path — lowered programs stay
+        byte-identical).  plugin: register the PJRT plugin (fail-fast
+        :class:`PluginRegistrationError` when absent).  tpu/gpu: nothing —
+        native PJRT discovery owns them.
+        """
+        if self.is_cpu:
+            provision_virtual_cpu(n_virtual_devices)
+        elif self.is_plugin:
+            register_pjrt_plugin(self.plugin_name)
+
+    # -- topology ----------------------------------------------------------
+    def mesh(self, n_devices: int | None = None, devices=None):
+        return client_mesh(n_devices=n_devices, devices=devices)
+
+    def host_groups(self, mesh):
+        return host_axis_groups(mesh)
+
+    # -- artifact routing --------------------------------------------------
+    def contracts_dir(self) -> Path:
+        return contracts_dir_for(self.name)
+
+    def record_fields(self) -> dict:
+        """Top-level ``backend``/``platform`` fields for bench records, so
+        budgets select by backend (``obs slo`` ``select.backend``) and a
+        future TPU session lands ``*_tpu`` artifacts next to CPU twins.
+        ``platform`` reports what jax actually initialized when a backend
+        is live (a cpu-fallback run says so); the spec's platform
+        otherwise."""
+        platform = self.platform
+        if backend_initialized():
+            try:
+                import jax
+
+                platform = jax.default_backend()
+            except Exception:
+                pass
+        return {"backend": self.name, "platform": platform}
+
+
+def get_backend(spec=None) -> Backend:
+    """Backend for a ``--backend`` spec; ``None`` (auto mode) and ``cpu``
+    both resolve to the cpu backend — auto-mode *policy* (probe, fall back)
+    lives in the callers that own the fallback decision."""
+    return Backend(spec if spec is not None else "cpu")
+
+
+def contracts_dir_for(backend) -> Path:
+    """hlolint contract directory for a backend.
+
+    cpu (and auto) is the checked-in ``analysis/contracts/`` — the 41
+    contract JSONs stay byte-identical.  Other backends get a sibling
+    subdirectory (``analysis/contracts/tpu/``,
+    ``analysis/contracts/plugin_<name>/``) so a future TPU session records
+    its fingerprints next to the CPU twins instead of overwriting them.
+    """
+    root = Path(__file__).resolve().parent.parent / "analysis" / "contracts"
+    name = parse_backend(backend) or "cpu"
+    if name == "cpu":
+        return root
+    return root / name.replace(_PLUGIN_PREFIX, "plugin_")

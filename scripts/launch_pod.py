@@ -1,0 +1,342 @@
+"""Multi-process pod launcher: one federated run across N real OS processes.
+
+The reference system is a multi-process federation (rank 0 server + N
+client workers over RPC); this launcher reproduces that topology as a real
+multi-controller SPMD pod on one machine: it forks N ``fed_tgan_tpu.cli``
+processes (rank 0 = init-protocol server AND ``jax.distributed``
+coordinator; ranks 1..N-1 = participants, one device each on the
+``clients`` mesh) and lets the existing ``parallel/multihost.py`` /
+``train/multihost.py`` path do the training — gloo CPU collectives by
+default, any ``runtime/backend.py`` spec via ``--backend``.
+
+What the launcher itself owns:
+
+- the **plan**: rank/port/env assignment, printed by ``--dry-run`` without
+  importing jax (or fed_tgan_tpu at all) in the parent — the doctor's
+  ``launch-pod`` check parses exactly that output;
+- **data**: with no ``--datapath``, deterministic toy shards are written
+  into the out dir (one per participant) so a bare
+  ``python scripts/launch_pod.py --processes 3`` is a complete run;
+- **departure**: a rank that dies mid-run is detected by the parent; the
+  surviving ranks abort themselves via the transport heartbeat machinery
+  (PR 1), and the parent reaps them after a grace period instead of
+  hanging on a half-dead world;
+- the **merge**: at exit the per-rank journals
+  (``pod_journal_rank<r>.jsonl``) are folded into ONE federation view via
+  ``obs.report.summarize_many`` — round streams deduplicated (server
+  stream wins), client streams unioned — written to
+  ``<out-dir>/federation.json``.
+
+Participants also pickle their final aggregated generator params
+(``params/params_rank<r>.pkl``) — post-psum params are replicated, so any
+rank's copy is the federation's result and must be bit-identical to a
+single-process ``FederatedTrainer`` run on the same shards/seed
+(``tests/test_launch_pod.py`` proves it).
+
+Usage::
+
+    python scripts/launch_pod.py --processes 3            # full toy run
+    python scripts/launch_pod.py --processes 3 --dry-run  # plan only
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: jax.distributed coordinator offset above the transport rendezvous port
+#: (mirrors parallel/multihost.JAX_PORT_OFFSET without importing it — the
+#: dry-run parent must stay jax-free)
+JAX_PORT_OFFSET = 1
+
+_COLORS = ("red", "green", "blue", "teal")
+
+
+def log(msg: str) -> None:
+    print(f"pod: {msg}", flush=True)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description="launch a multi-process federated pod "
+                    "(rank 0 coordinator + N-1 participants) on one machine")
+    ap.add_argument("--processes", type=int, default=3,
+                    help="total OS processes incl. the rank-0 coordinator "
+                         "(so N-1 federated participants; default 3)")
+    ap.add_argument("--backend", default="cpu",
+                    help="runtime/backend.py spec for every rank "
+                         "(cpu/tpu/gpu/plugin:<name>; default cpu — gloo "
+                         "cross-process collectives on virtual devices)")
+    ap.add_argument("--out-dir", default=None, metavar="DIR",
+                    help="artifact directory (shards, per-rank logs and "
+                         "journals, params, federation.json); default "
+                         "pod_run_<port> under the repo root")
+    ap.add_argument("--datapath", nargs="*", default=None, metavar="CSV",
+                    help="one shard CSV per participant (N-1 paths); "
+                         "default: deterministic toy shards written into "
+                         "the out dir")
+    ap.add_argument("--categorical", nargs="*", default=["color", "flag"],
+                    help="categorical columns of the shards "
+                         "(default matches the toy shards)")
+    ap.add_argument("--rows-per-shard", type=int, default=180,
+                    help="toy-shard rows per participant (default 180)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="transport rendezvous port (jax.distributed "
+                         "coordinator binds port+1); default pid-derived")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=40)
+    ap.add_argument("--embedding-dim", type=int, default=16)
+    ap.add_argument("--sample-every", type=int, default=0,
+                    help="epochs between snapshot CSVs (0 = only at end)")
+    ap.add_argument("--sample-rows", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="hard wall for the whole pod run (seconds)")
+    ap.add_argument("--grace", type=float, default=60.0,
+                    help="after a rank dies, how long survivors get to "
+                         "abort via the heartbeat path before the parent "
+                         "terminates them (seconds)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the rank/port/env plan and exit without "
+                         "importing jax (or spawning anything)")
+    return ap
+
+
+def write_toy_shards(out_dir: str, n_shards: int, rows: int,
+                     seed: int) -> list:
+    """Deterministic toy shard CSVs (schema: amount,score,color,flag —
+    the same shape the multihost tests train on).  Pure stdlib so the
+    parent stays jax/numpy-free."""
+    rng = random.Random(seed)
+    paths = []
+    for s in range(n_shards):
+        path = os.path.join(out_dir, f"shard{s}.csv")
+        with open(path, "w", newline="") as fh:
+            w = csv.writer(fh)
+            w.writerow(["amount", "score", "color", "flag"])
+            for _ in range(rows):
+                w.writerow([round(rng.uniform(0.0, 100.0), 4),
+                            rng.randrange(0, 50),
+                            rng.choice(_COLORS),
+                            rng.choice(("yes", "no"))])
+        paths.append(path)
+    return paths
+
+
+def build_plan(args, out_dir: str, port: int, datapaths: list) -> list:
+    """One dict per rank: the exact command and env the child will get."""
+    journal = os.path.join(out_dir, "pod_journal.jsonl")
+    params_dir = os.path.join(out_dir, "params")
+    plan = []
+    for rank in range(args.processes):
+        cmd = [
+            sys.executable, "-m", "fed_tgan_tpu.cli",
+            "--dataset", "custom",
+            "--categorical", *args.categorical,
+            "-world_size", str(args.processes),
+            "-ip", "127.0.0.1", "-port", str(port),
+            "-rank", str(rank),
+            # rank 0 never reads its datapath (the server holds no shard)
+            # but the flag keeps the reference-compatible launch shape
+            "--datapath", datapaths[max(rank - 1, 0)],
+            "--backend", args.backend,
+            "--out-dir", out_dir,
+            "-epochs", str(args.epochs),
+            "--sample-every", str(args.sample_every),
+            "--sample-rows", str(args.sample_rows),
+            "--batch-size", str(args.batch_size),
+            "--embedding-dim", str(args.embedding_dim),
+            "--seed", str(args.seed),
+            "--journal", journal,
+            "--params-out", params_dir,
+        ]
+        plan.append({
+            "rank": rank,
+            "role": "coordinator" if rank == 0 else "participant",
+            "port": port,
+            "jax_coordinator_port": port + JAX_PORT_OFFSET,
+            "datapath": datapaths[max(rank - 1, 0)],
+            "journal": journal.replace(".jsonl", f"_rank{rank}.jsonl"),
+            "env": {"XLA_FLAGS": None,  # unset: each rank does its own
+                                        # device-count flag surgery
+                    "PYTHONPATH": REPO},
+            "cmd": cmd,
+        })
+    return plan
+
+
+def print_plan(plan: list) -> None:
+    for p in plan:
+        env = " ".join(f"{k}={'<unset>' if v is None else v}"
+                       for k, v in sorted(p["env"].items()))
+        print(f"rank {p['rank']} role={p['role']} port={p['port']} "
+              f"jax_coordinator_port={p['jax_coordinator_port']} "
+              f"datapath={p['datapath']} env[{env}] "
+              f"cmd: {' '.join(p['cmd'])}", flush=True)
+    # the doctor's launch-pod check pins this: planning must never cost a
+    # jax import (or a backend init) in the parent
+    print(f"parent_jax_imported={'jax' in sys.modules}", flush=True)
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    # each rank replaces the device-count flag itself (initialize_multihost
+    # flag surgery); an inherited stale value would fight it
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_pod(args, plan: list, out_dir: str) -> dict:
+    """Spawn every rank, supervise, reap.  Returns rank -> exit code."""
+    env = _child_env()
+    procs = {}
+    logs = {}
+    for p in plan:
+        lpath = os.path.join(out_dir, f"rank{p['rank']}.log")
+        lf = open(lpath, "w")
+        logs[p["rank"]] = (lpath, lf)
+        procs[p["rank"]] = subprocess.Popen(
+            p["cmd"], cwd=REPO, env=env, stdout=lf, stderr=subprocess.STDOUT)
+        log(f"rank {p['rank']} ({p['role']}) pid={procs[p['rank']].pid} "
+            f"log={lpath}")
+
+    deadline = time.time() + args.timeout
+    codes: dict = {}
+    departed = None  # (rank, code) of the first abnormal exit
+    grace_end = None
+    try:
+        while len(codes) < len(procs):
+            now = time.time()
+            for rank, proc in procs.items():
+                if rank in codes:
+                    continue
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                codes[rank] = rc
+                if rc != 0 and departed is None:
+                    departed = (rank, rc)
+                    # survivors notice the dead peer through heartbeat
+                    # lapse and abort cleanly on their own; only reap by
+                    # force if they don't
+                    grace_end = now + args.grace
+                    log(f"rank {rank} departed (exit {rc}); giving "
+                        f"survivors {args.grace:.0f}s to abort via "
+                        "heartbeat")
+            if len(codes) == len(procs):
+                break
+            if now > deadline or (grace_end is not None and now > grace_end):
+                why = "timeout" if now > deadline else "grace expired"
+                log(f"{why}: terminating remaining ranks")
+                for rank, proc in procs.items():
+                    if rank not in codes:
+                        proc.terminate()
+                for rank, proc in procs.items():
+                    if rank not in codes:
+                        try:
+                            codes[rank] = proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                            codes[rank] = proc.wait()
+                break
+            time.sleep(0.5)
+    finally:
+        for _, lf in logs.values():
+            lf.close()
+
+    for rank, rc in sorted(codes.items()):
+        if rc != 0:
+            lpath = logs[rank][0]
+            try:
+                with open(lpath) as fh:
+                    tail = "".join(fh.readlines()[-15:])
+            except OSError:
+                tail = "<log unreadable>"
+            log(f"rank {rank} exit {rc}; log tail:\n{tail}")
+    return codes
+
+
+def merge_journals(plan: list, out_dir: str, codes: dict) -> str | None:
+    """Fold the per-rank journals into one federation view
+    (federation.json).  Best-effort: merges whatever ranks managed to
+    write, even after a failed run — that IS the forensics artifact."""
+    paths = [p["journal"] for p in plan if os.path.exists(p["journal"])]
+    if not paths:
+        log("no rank journals found; nothing to merge")
+        return None
+    sys.path.insert(0, REPO)
+    from fed_tgan_tpu.obs.report import summarize_many  # jax-free
+
+    summary = summarize_many(paths, on_skip=lambda line: log(f"merge: {line}"))
+    summary["pod"] = {
+        "processes": len(plan),
+        "exit_codes": {str(r): c for r, c in sorted(codes.items())},
+        "rank_journals": paths,
+    }
+    out = os.path.join(out_dir, "federation.json")
+    with open(out, "w") as fh:
+        json.dump(summary, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    rounds = (summary.get("rounds") or {}).get("total_rounds")
+    log(f"merged {len(paths)} rank journal(s) -> {out} "
+        f"({summary['events']} events, rounds={rounds})")
+    return out
+
+
+def main() -> int:
+    args = build_parser().parse_args()
+    if args.processes < 2:
+        print("--processes must be >= 2 (rank 0 coordinator + at least "
+              "one participant)", file=sys.stderr)
+        return 2
+    port = args.port if args.port is not None else 23000 + os.getpid() % 2000
+    out_dir = args.out_dir or os.path.join(REPO, f"pod_run_{port}")
+    n_participants = args.processes - 1
+
+    if args.datapath:
+        if len(args.datapath) != n_participants:
+            print(f"--datapath needs exactly {n_participants} shard CSVs "
+                  f"(one per participant), got {len(args.datapath)}",
+                  file=sys.stderr)
+            return 2
+        datapaths = [os.path.abspath(p) for p in args.datapath]
+    elif args.dry_run:
+        # plan only: name the shards the real run would write, touch nothing
+        datapaths = [os.path.join(out_dir, f"shard{s}.csv")
+                     for s in range(n_participants)]
+    else:
+        os.makedirs(out_dir, exist_ok=True)
+        datapaths = write_toy_shards(out_dir, n_participants,
+                                     args.rows_per_shard, args.seed)
+
+    plan = build_plan(args, out_dir, port, datapaths)
+    print(f"pod plan: processes={args.processes} port={port} "
+          f"backend={args.backend} out={out_dir}", flush=True)
+    print_plan(plan)
+    if args.dry_run:
+        return 0
+
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = time.time()
+    codes = run_pod(args, plan, out_dir)
+    merge_journals(plan, out_dir, codes)
+    ok = all(rc == 0 for rc in codes.values()) and len(codes) == len(plan)
+    if ok:
+        log(f"pod complete: {args.processes} processes, "
+            f"{args.epochs} rounds in {time.time() - t0:.1f}s; params in "
+            f"{os.path.join(out_dir, 'params')}")
+        return 0
+    log(f"pod FAILED: exit codes {codes}")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,10 @@ watcher encodes the session's hard-won rules:
 - persist every captured JSON line immediately (a later wedge must not
   cost evidence already earned).
 
+Probing rides ``runtime/backend.py``'s ``Backend.probe()`` — the same
+probe/stamp-cache machinery the CLI, bench, and doctor share, so there is
+exactly one source of truth for "is the accelerator alive".
+
 Usage:  nohup python scripts/tpu_watch.py --out-prefix BENCH_r03 &
 """
 from __future__ import annotations
@@ -36,12 +40,12 @@ def log(msg: str) -> None:
     print(line, flush=True)
 
 
-def probe_once(timeout_s: int) -> bool:
+def probe_once(timeout_s: int, backend: str = "tpu") -> bool:
     sys.path.insert(0, REPO)
-    from fed_tgan_tpu.parallel.mesh import probe_backend_responsive
-    ok, detail = probe_backend_responsive(timeout_s=timeout_s, attempts=1)
-    log(f"probe -> {ok} {detail or ''}".rstrip())
-    return bool(ok)
+    from fed_tgan_tpu.runtime.backend import get_backend
+    health = get_backend(backend).probe(timeout_s=timeout_s, attempts=1)
+    log(f"probe -> {health.ok} {health.reason or ''}".rstrip())
+    return bool(health)
 
 
 # pseudo-workload name -> extra bench args (the plain names pass through)

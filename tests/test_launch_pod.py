@@ -1,0 +1,205 @@
+"""scripts/launch_pod.py: the multi-process pod launcher.
+
+Fast tier: plan construction, the jax-free ``--dry-run`` parent, argument
+validation, and deterministic toy-shard synthesis — all without spawning a
+pod.  Slow/multiproc tier: a real 3-process federated run whose final
+params must be bit-identical to the single-process ``FederatedTrainer``
+on the same shards/seed, with the per-rank journals merged into one
+federation view containing every rank's round stream exactly once.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "launch_pod.py")
+
+
+@pytest.fixture(scope="module")
+def pod():
+    """The launcher as a module (scripts/ is not a package)."""
+    spec = importlib.util.spec_from_file_location("launch_pod", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(args, **kw):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable, SCRIPT] + args,
+                          capture_output=True, text=True, env=env,
+                          cwd=REPO, **kw)
+
+
+def test_module_is_jax_free(pod):
+    """The supervisor must plan and fork without paying a jax (or package)
+    import — the doctor's launch-pod check and --dry-run rely on it."""
+    src = open(SCRIPT).read()
+    head = src.split("def merge_journals")[0]
+    assert "import jax" not in head
+    assert "import fed_tgan_tpu" not in head
+    assert "from fed_tgan_tpu" not in head
+
+
+def test_dry_run_plan(tmp_path):
+    res = _run(["--processes", "3", "--dry-run",
+                "--out-dir", str(tmp_path), "--port", "23999"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    lines = res.stdout.splitlines()
+    ranks = [ln for ln in lines if ln.startswith("rank ")]
+    assert len(ranks) == 3
+    assert "role=coordinator" in ranks[0]
+    assert all("role=participant" in ln for ln in ranks[1:])
+    assert all("port=23999" in ln for ln in ranks)
+    # the jax.distributed coordinator rides the transport port + 1
+    assert all("jax_coordinator_port=24000" in ln for ln in ranks)
+    # env plan: XLA_FLAGS cleared, repo on PYTHONPATH
+    assert all("XLA_FLAGS=<unset>" in ln for ln in ranks)
+    # planning never imports jax in the parent
+    assert "parent_jax_imported=False" in lines
+    # a dry run touches nothing
+    assert not (tmp_path / "shard0.csv").exists()
+    assert not (tmp_path / "params").exists()
+
+
+def test_plan_shard_assignment(pod):
+    """Rank r trains participant r's shard; rank 0 (no shard of its own)
+    gets shard 0's path for a reference-compatible launch shape."""
+    args = pod.build_parser().parse_args(
+        ["--processes", "4", "--port", "24100"])
+    paths = [f"/x/shard{i}.csv" for i in range(3)]
+    plan = pod.build_plan(args, "/x/out", 24100, paths)
+    assert [p["datapath"] for p in plan] == [
+        "/x/shard0.csv", "/x/shard0.csv", "/x/shard1.csv", "/x/shard2.csv"]
+    assert [p["role"] for p in plan] == [
+        "coordinator", "participant", "participant", "participant"]
+    # per-rank journal naming matches cli's _rank<r> suffixing
+    assert plan[2]["journal"].endswith("pod_journal_rank2.jsonl")
+    for rank, p in enumerate(plan):
+        cmd = p["cmd"]
+        assert cmd[cmd.index("-rank") + 1] == str(rank)
+        assert cmd[cmd.index("-world_size") + 1] == "4"
+        assert cmd[cmd.index("--backend") + 1] == "cpu"
+
+
+def test_toy_shards_deterministic(pod, tmp_path):
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    pa = pod.write_toy_shards(str(tmp_path / "a"), 2, 50, 7)
+    pb = pod.write_toy_shards(str(tmp_path / "b"), 2, 50, 7)
+    for x, y in zip(pa, pb):
+        assert open(x).read() == open(y).read()
+    header = open(pa[0]).readline().strip()
+    assert header == "amount,score,color,flag"
+
+
+def test_rejects_bad_arguments(tmp_path):
+    res = _run(["--processes", "1", "--dry-run"])
+    assert res.returncode == 2
+    assert "--processes must be >= 2" in res.stderr
+    res = _run(["--processes", "3", "--dry-run",
+                "--datapath", str(tmp_path / "one.csv")])
+    assert res.returncode == 2
+    assert "exactly 2 shard CSVs" in res.stderr
+
+
+@pytest.mark.slow
+@pytest.mark.multiproc
+def test_pod_bit_identical_and_merged_journal(tmp_path):
+    """The acceptance run: a 3-process pod on CPU trains the federated
+    program across real OS processes; the aggregated generator params are
+    bit-identical to a single-process FederatedTrainer on the same
+    shards/seed (same program, laid out across hosts), and the merged
+    journal holds every rank's stream with the round chunks deduplicated
+    to exactly one copy."""
+    import json
+    import pickle
+
+    import numpy as np
+
+    port = 26000 + os.getpid() % 2000
+    out = tmp_path / "pod"
+    res = _run(["--processes", "3", "--out-dir", str(out),
+                "--port", str(port), "--timeout", "600"], timeout=700)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+    # ---- params: every participant pickled the same replicated tree ----
+    with open(out / "params" / "params_rank1.pkl", "rb") as f:
+        got1 = pickle.load(f)
+    with open(out / "params" / "params_rank2.pkl", "rb") as f:
+        got2 = pickle.load(f)
+
+    # the single-process reference: same shards, same seed, same BGM
+    # backend as the cli (jax), on a 2-virtual-device platform — one
+    # device per participant, the pod's layout (XLA lowers a different
+    # program on other device counts; bit-identity is a statement about
+    # the SAME program laid out across processes)
+    ref = tmp_path / "ref_driver.py"
+    shard_paths = [str(out / "shard0.csv"), str(out / "shard1.csv")]
+    ref.write_text(f"""
+import pickle
+import numpy as np
+import pandas as pd
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+kwargs = dict(categorical_columns=["color", "flag"],
+              non_negative_columns=[], date_formats={{}},
+              target_column="", problem_type="", selected_columns=None)
+clients = [TablePreprocessor(frame=pd.read_csv(p), name="shard0", **kwargs)
+           for p in {shard_paths!r}]
+init = federated_initialize(clients, seed=0, backend="jax")
+trainer = FederatedTrainer(
+    init, config=TrainConfig(batch_size=40, embedding_dim=16), seed=0)
+trainer.fit(3)
+import jax
+want = jax.tree.map(lambda x: np.asarray(x)[0], trainer.models.params_g)
+with open(r"{tmp_path}" + "/params_want.pkl", "wb") as f:
+    pickle.dump(want, f)
+""")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rr = subprocess.run([sys.executable, str(ref)], cwd=REPO, env=env,
+                        capture_output=True, text=True, timeout=600)
+    assert rr.returncode == 0, rr.stdout + rr.stderr
+    with open(tmp_path / "params_want.pkl", "rb") as f:
+        want = pickle.load(f)
+
+    import jax
+
+    for a, b, c in zip(jax.tree.leaves(want), jax.tree.leaves(got1),
+                       jax.tree.leaves(got2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(c))
+
+    # ---- journal merge: one federation view, rounds exactly once ----
+    with open(out / "federation.json") as f:
+        fed = json.load(f)
+    assert len(fed["paths"]) == 3  # every rank's journal made it in
+    assert fed["pod"]["exit_codes"] == {"0": 0, "1": 0, "2": 0}
+    assert fed["rounds"]["total_rounds"] == 3  # deduplicated, not 3x3
+
+    # every rank journalled its own round chunks...
+    per_rank_rounds = {}
+    for r in range(3):
+        with open(out / f"pod_journal_rank{r}.jsonl") as f:
+            evs = [json.loads(ln) for ln in f if ln.strip()]
+        per_rank_rounds[r] = [e for e in evs if e.get("type") == "round"]
+        assert any(e.get("type") == "run_start" for e in evs)
+    assert all(per_rank_rounds.values())
+    total_rounds_per_rank = {
+        r: sum(c.get("rounds", 0) for c in chunks)
+        for r, chunks in per_rank_rounds.items()}
+    assert set(total_rounds_per_rank.values()) == {3}
+    # ...but the merged view keeps ONE stream's chunks (server canonical),
+    # so the 3 ranks' round events fold to a single copy, not 3x
+    assert fed["rounds"]["chunks"] == len(per_rank_rounds[0])
+    assert fed["by_type"]["round"] == 3  # raw union: one event per rank

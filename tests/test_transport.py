@@ -191,6 +191,53 @@ def _toy_shards(tmp_path, n=360, n_shards=2):
     return shards, paths
 
 
+def _reference_params(tmp_path, paths, epochs, env):
+    """Single-process FederatedTrainer params for the same shards/seed,
+    computed in a subprocess on a 2-virtual-device platform — one device
+    per participant, i.e. the multihost layout.  XLA lowers a DIFFERENT
+    program on the conftest 8-device mesh (fusion picks another float
+    order, ~1e-5 relative drift), and bit-identity is a statement about
+    the SAME program laid out across hosts, so the reference must match
+    the participant topology."""
+    import pickle
+    import subprocess
+    import sys
+
+    ref = tmp_path / "ref_driver.py"
+    ref.write_text(f"""
+import pickle
+import numpy as np
+import pandas as pd
+from fed_tgan_tpu.data.ingest import TablePreprocessor
+from fed_tgan_tpu.federation.init import federated_initialize
+from fed_tgan_tpu.train.federated import FederatedTrainer
+from fed_tgan_tpu.train.steps import TrainConfig
+clients = [
+    TablePreprocessor(
+        frame=pd.read_csv(p), name="toy",
+        categorical_columns=["color", "flag"], target_column="flag",
+        problem_type="binary_classification",
+    )
+    for p in {[str(p) for p in paths]!r}
+]
+init = federated_initialize(clients, seed=0)
+trainer = FederatedTrainer(
+    init, config=TrainConfig(batch_size=40, embedding_dim=16), seed=0)
+trainer.fit({epochs})
+import jax
+want = jax.tree.map(lambda x: np.asarray(x)[0], trainer.models.params_g)
+with open(r"{tmp_path}" + "/params_want.pkl", "wb") as f:
+    pickle.dump(want, f)
+""")
+    env_ref = dict(env)
+    env_ref["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    res = subprocess.run([sys.executable, str(ref)], cwd="/root/repo",
+                         env=env_ref, capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    with open(tmp_path / "params_want.pkl", "rb") as f:
+        return pickle.load(f)
+
+
 @pytest.mark.slow
 def test_cli_multihost_training_end_to_end(tmp_path):
     """The reference's FULL multi-process run, not just init (reference
@@ -252,12 +299,7 @@ def test_multihost_training_bit_identical_to_in_process(tmp_path):
     import subprocess
     import sys
 
-    from fed_tgan_tpu.data.ingest import TablePreprocessor
-    from fed_tgan_tpu.federation.init import federated_initialize
-    from fed_tgan_tpu.train.federated import FederatedTrainer
-    from fed_tgan_tpu.train.steps import TrainConfig
-
-    shards, paths = _toy_shards(tmp_path)
+    _, paths = _toy_shards(tmp_path)
     port = 23000 + os.getpid() % 2000
 
     driver = tmp_path / "mh_driver.py"
@@ -308,21 +350,9 @@ print(f"rank {{rank}} ok")
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
 
-    # the same two rounds in-process (this test runs under the 8-device
-    # virtual CPU conftest platform)
-    clients = [
-        TablePreprocessor(
-            frame=s, name="toy", categorical_columns=["color", "flag"],
-            target_column="flag", problem_type="binary_classification",
-        )
-        for s in shards
-    ]
-    init = federated_initialize(clients, seed=0)
-    trainer = FederatedTrainer(init, config=TrainConfig(batch_size=40, embedding_dim=16), seed=0)
-    trainer.fit(2)
+    # the same two rounds single-process, on the matched 2-device layout
+    want = _reference_params(tmp_path, paths, 2, env)
     import jax
-
-    want = jax.tree.map(lambda x: np.asarray(x)[0], trainer.models.params_g)
 
     with open(tmp_path / "params_rank1.pkl", "rb") as f:
         got = pickle.load(f)
@@ -341,12 +371,7 @@ def test_multihost_checkpoint_resume_bit_exact(tmp_path):
     import subprocess
     import sys
 
-    from fed_tgan_tpu.data.ingest import TablePreprocessor
-    from fed_tgan_tpu.federation.init import federated_initialize
-    from fed_tgan_tpu.train.federated import FederatedTrainer
-    from fed_tgan_tpu.train.steps import TrainConfig
-
-    shards, paths = _toy_shards(tmp_path)
+    _, paths = _toy_shards(tmp_path)
     port = 25000 + os.getpid() % 2000
 
     driver = tmp_path / "mh_resume_driver.py"
@@ -406,21 +431,10 @@ print(f"rank {{rank}} ok")
     assert (tmp_path / "mh_ckpt" / "multihost_rank2.pkl").exists()
     launch(4, "1")  # resume -> rounds 2-3
 
-    clients = [
-        TablePreprocessor(
-            frame=s, name="toy", categorical_columns=["color", "flag"],
-            target_column="flag", problem_type="binary_classification",
-        )
-        for s in shards
-    ]
-    init = federated_initialize(clients, seed=0)
-    trainer = FederatedTrainer(
-        init, config=TrainConfig(batch_size=40, embedding_dim=16), seed=0
-    )
-    trainer.fit(4)
+    # one uninterrupted fit(4) single-process, matched 2-device layout
+    want = _reference_params(tmp_path, paths, 4, env)
     import jax
 
-    want = jax.tree.map(lambda x: np.asarray(x)[0], trainer.models.params_g)
     with open(tmp_path / "params_resume_rank1.pkl", "rb") as f:
         got = pickle.load(f)
     for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
