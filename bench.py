@@ -1830,6 +1830,8 @@ def bench_serving_fleet(tenants: int = 4, clients_per_tenant: int = 8,
     )
     from fed_tgan_tpu.serve.pool import RowPool
 
+    from fed_tgan_tpu.analysis import lockwatch
+
     tmp = tempfile.mkdtemp(prefix="fed_tgan_bench_fleet_")
     svc = None
     old_switch = syslib.getswitchinterval()
@@ -1838,6 +1840,12 @@ def bench_serving_fleet(tenants: int = 4, clients_per_tenant: int = 8,
         # switch interval keeps their scheduling (and hence per-tenant
         # throughput) even instead of starvation-lumpy
         syslib.setswitchinterval(0.001)
+        # the deadlock sanitizer rides the whole window in record mode:
+        # every lock the fleet allocates below is watched, hold/wait
+        # times feed the lock/* SLO figures, and a closed order cycle
+        # surfaces in the record instead of as a wedged bench
+        lockwatch.clear()
+        lockwatch.install(on_deadlock="record")
         names = [f"t{i}" for i in range(tenants)]
         for name in names:
             build_demo_artifact(os.path.join(tmp, name), rows=400, epochs=1,
@@ -1860,6 +1868,8 @@ def bench_serving_fleet(tenants: int = 4, clients_per_tenant: int = 8,
             workers=workers, coalesce_window_s=coalesce_window_s,
             http_mode="asyncio",
         ).start()
+        lockwatch.set_name(svc._adm_lock, "fleet_adm")
+        lockwatch.set_name(pool._lock, "row_pool")
         host, port = "127.0.0.1", svc.port
 
         # quota-shed proof: t0 is capped far below its fair request rate;
@@ -2081,7 +2091,21 @@ def bench_serving_fleet(tenants: int = 4, clients_per_tenant: int = 8,
         fairness = (round(min(unpinned) / max(unpinned), 3)
                     if unpinned and max(unpinned) > 0 else 0)
         n_buckets = min(64, int(elapsed // 10) + 1)
+        lw = lockwatch.summary()
+        lock_figures = {}
+        for lname in ("fleet_adm", "row_pool"):
+            ls = lw.get(lname)
+            if ls:
+                lock_figures[f"lock/{lname}/hold_p99_ms"] = ls["hold_p99_ms"]
+                lock_figures[f"lock/{lname}/wait_p99_ms"] = ls["wait_p99_ms"]
+                lock_figures[f"lock/{lname}/contentions"] = float(
+                    ls["contentions"])
+        lock_reports = (lockwatch.reports("cycle")
+                        + lockwatch.reports("reentry"))
         return {
+            **lock_figures,
+            "lock_order_reports": [r.detail for r in lock_reports],
+            "locks_watched": len(lw),
             "metric": "bench_serving_fleet",
             "value": round(total_requests / max(elapsed, 1e-9), 1),
             "unit": "requests/s served",
@@ -2136,6 +2160,8 @@ def bench_serving_fleet(tenants: int = 4, clients_per_tenant: int = 8,
         }
     finally:
         syslib.setswitchinterval(old_switch)
+        if lockwatch.installed():
+            lockwatch.uninstall()
         if svc is not None:
             try:
                 svc.shutdown(drain=False)

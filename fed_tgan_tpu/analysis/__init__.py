@@ -2,7 +2,8 @@
 
 Three prongs, one goal -- keep the hot paths provably clean:
 
-* :mod:`fed_tgan_tpu.analysis.lint` -- stdlib-AST rules J01-J06 (host
+* :mod:`fed_tgan_tpu.analysis.lint` -- stdlib-AST rules J01-J06 + the
+  :mod:`~fed_tgan_tpu.analysis.concurrency` lockset rules L01-L04 (host
   syncs in hot loops, PRNG key reuse, recompile hazards, numpy-in-jit,
   unguarded shared state, dtype promotion) with a checked-in ratcheting
   baseline.  Run ``python -m fed_tgan_tpu.analysis``.

@@ -1,6 +1,7 @@
 """``python -m fed_tgan_tpu.analysis`` -- the jaxlint + hlolint CLI.
 
-Default mode is the static lint (rules J01-J06, no JAX import).
+Default mode is the static lint (rules J01-J06 + the locklint
+concurrency rules L01-L04, no JAX import).
 ``--contracts`` switches to the IR program contracts: every jitted
 entrypoint is AOT-lowered on a simulated 8-device CPU mesh and its
 fingerprint diffed against the checked-in ``analysis/contracts/*.json``
@@ -29,10 +30,35 @@ from fed_tgan_tpu.analysis.lint import (
 from fed_tgan_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
 
 
+def expand_rule_ids(spec: str) -> list:
+    """'J01,L02' -> ['J01', 'L02']; 'L01-L04' expands the numeric range
+    within one prefix letter.  Unknown shapes raise KeyError (the same
+    path as an unknown id, so the CLI reports it as usage: exit 2)."""
+    import re as _re
+
+    out = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        m = _re.fullmatch(r"([A-Z]+)(\d+)-([A-Z]+)?(\d+)", tok)
+        if m:
+            prefix, lo, prefix2, hi = m.groups()
+            if prefix2 is not None and prefix2 != prefix:
+                raise KeyError(tok)
+            width = len(m.group(2))
+            out.extend(f"{prefix}{n:0{width}d}"
+                       for n in range(int(lo), int(hi) + 1))
+        else:
+            out.append(tok)
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m fed_tgan_tpu.analysis",
-        description="JAX-aware lint (J01-J06) and lowered-HLO program "
+        description="JAX-aware lint (J01-J06 + locklint L01-L04) "
+                    "and lowered-HLO program "
                     "contracts (--contracts) over fed_tgan_tpu",
     )
     ap.add_argument("paths", nargs="*",
@@ -44,7 +70,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--baseline-update", action="store_true",
                     help="rewrite the baseline to the current finding set")
     ap.add_argument("--rules", default="",
-                    help="comma-separated rule ids to run (default: all)")
+                    help="comma-separated rule ids to run, ranges allowed "
+                         "(e.g. 'L01-L04' or 'J01,J03,L02'; default: all)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--contracts", action="store_true",
                     help="check the lowered-HLO program contracts instead "
@@ -97,8 +124,7 @@ def main(argv=None) -> int:
     rules = None
     if args.rules:
         try:
-            rules = [RULES_BY_ID[r.strip()]
-                     for r in args.rules.split(",") if r.strip()]
+            rules = [RULES_BY_ID[r] for r in expand_rule_ids(args.rules)]
         except KeyError as exc:
             print(f"jaxlint: unknown rule {exc} "
                   f"(have {sorted(RULES_BY_ID)})", file=sys.stderr)

@@ -1,4 +1,4 @@
-"""jaxlint driver: walk files, run the J01-J06 rules, diff the baseline.
+"""jaxlint driver: walk files, run the J01-J06 + L01-L04 rules, diff the baseline.
 
 Pure stdlib + AST -- importing this module never imports JAX, so the
 lint gate runs in milliseconds with no tracing.  Findings are keyed

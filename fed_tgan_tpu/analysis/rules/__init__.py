@@ -2,8 +2,18 @@
 
 Each rule exposes ``rule_id``, ``title``, ``hint`` and
 ``check(module) -> iter[(rule_id, line, message, hint)]``.
+
+The J01-J06 rules are the JAX-facing lint; the L01-L04 rules are the
+locklint concurrency prong (``analysis/concurrency/``) and share the
+same driver, suppression comments and baseline.
 """
 
+from fed_tgan_tpu.analysis.concurrency.rules import (
+    BlockingUnderLockRule,
+    LockLeakRule,
+    LockOrderRule,
+    UnguardedFieldRule,
+)
 from fed_tgan_tpu.analysis.rules.dtype_promotion import DtypePromotionRule
 from fed_tgan_tpu.analysis.rules.host_sync import HostSyncRule
 from fed_tgan_tpu.analysis.rules.numpy_in_jit import NumpyInJitRule
@@ -18,10 +28,15 @@ ALL_RULES = (
     NumpyInJitRule(),
     SharedStateRule(),
     DtypePromotionRule(),
+    UnguardedFieldRule(),
+    LockOrderRule(),
+    BlockingUnderLockRule(),
+    LockLeakRule(),
 )
 
 RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}
 
 __all__ = ["ALL_RULES", "RULES_BY_ID", "DtypePromotionRule", "HostSyncRule",
            "NumpyInJitRule", "PrngReuseRule", "RecompileRule",
-           "SharedStateRule"]
+           "SharedStateRule", "UnguardedFieldRule", "LockOrderRule",
+           "BlockingUnderLockRule", "LockLeakRule"]

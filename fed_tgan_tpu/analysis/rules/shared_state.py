@@ -1,19 +1,23 @@
-"""J05 -- unguarded shared mutable state in threaded modules.
+"""J05 -- unguarded shared mutable state (deprecation shim).
 
-Applies to modules that import ``threading`` (the serve layer's HTTP
-handler threads + batch worker, metrics, snapshot writers).  For each
-class the rule collects lock attributes (``self._lock =
-threading.Lock()/RLock()/Condition()``) and intrinsically thread-safe
-attributes (``queue.Queue`` family), then flags non-atomic mutations
-performed outside a ``with self._lock:`` block:
+J05 was a *lexical* scan: per class it collected lock attributes and
+flagged non-atomic ``self.attr`` mutations outside a ``with
+self._lock:`` block.  It could not see cross-function lock flow, so a
+``_shed``-style private helper that is only ever called under the lock
+either got a false positive or an inline disable -- and a genuine
+deadlock (PR 9's ``submit`` -> ``_shed`` re-acquire) sailed through.
 
-* ``self.attr[key] = ...`` / ``del self.attr[key]`` -- container writes;
-* ``self.attr += ...`` -- read-modify-write;
-* mutating method calls (``.append`` / ``.update`` / ``.pop`` ...) on
-  ``self.attr`` containers.
+Its findings migrated into the interprocedural locklint prong
+(``analysis/concurrency/``): **L01** carries the unguarded-mutation
+semantics with call-graph-propagated locksets, and L02-L04 cover the
+ordering/blocking/leak hazards the lexical scan never could.  The rule
+id stays registered so stale ``--rules J05`` invocations and old
+``# jaxlint: disable=J05`` comments keep parsing, but ``check`` yields
+nothing.
 
-Plain rebinds (``self.attr = value``) are atomic under the GIL and are
-not flagged; ``__init__`` runs before any thread exists and is skipped.
+The type inventories below (what counts as a lock / a thread-safe
+container / a mutator call) remain the single source of truth, shared
+with ``analysis/concurrency/model.py``.
 """
 
 from __future__ import annotations
@@ -21,11 +25,9 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from fed_tgan_tpu.analysis.rules.base import dotted
-
 RULE_ID = "J05"
-HINT = ("guard the mutation with the class lock (`with self._lock:`) or "
-        "use a thread-safe structure (queue.Queue)")
+HINT = ("J05 is deprecated: the interprocedural lockset rule L01 "
+        "(analysis/concurrency/) now carries these findings")
 
 _LOCK_TYPES = ("threading.Lock", "threading.RLock", "threading.Condition",
                "Lock", "RLock", "Condition")
@@ -60,115 +62,12 @@ def _self_attr(node) -> str:
 
 
 class SharedStateRule:
+    """Deprecation shim: registered for id/CLI compatibility, finds
+    nothing.  See L01 in ``analysis/concurrency/rules.py``."""
+
     rule_id = RULE_ID
-    title = "unguarded shared state"
+    title = "unguarded shared state (deprecated -> L01)"
     hint = HINT
 
     def check(self, mod) -> Iterator:
-        in_serve = "/serve/" in mod.relpath.replace("\\", "/")
-        if not in_serve and not _imports_threading(mod.tree):
-            return
-        findings: dict = {}
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef):
-                self._check_class(node, findings)
-        for line in sorted(findings):
-            yield (self.rule_id, line, findings[line], self.hint)
-
-    def _check_class(self, cls, findings) -> None:
-        locks: set = set()
-        safe: set = set()
-        for node in ast.walk(cls):
-            if isinstance(node, ast.Assign) and \
-                    isinstance(node.value, ast.Call):
-                d = dotted(node.value.func) or ""
-                for t in node.targets:
-                    attr = _self_attr(t)
-                    if not attr:
-                        continue
-                    if d in _LOCK_TYPES:
-                        locks.add(attr)
-                    elif d in _SAFE_TYPES:
-                        safe.add(attr)
-
-        for item in cls.body:
-            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and item.name != "__init__":
-                self._scan(item.body, held=False, locks=locks, safe=safe,
-                           findings=findings)
-
-    def _holds_lock(self, withstmt, locks) -> bool:
-        for item in withstmt.items:
-            expr = item.context_expr
-            if isinstance(expr, ast.Call):
-                expr = expr.func
-                if isinstance(expr, ast.Attribute) and \
-                        expr.attr in ("acquire",):
-                    expr = expr.value
-            if _self_attr(expr) in locks:
-                return True
-        return False
-
-    def _flag(self, findings, node, message) -> None:
-        findings.setdefault(node.lineno, message)
-
-    def _scan(self, stmts, held, locks, safe, findings) -> None:
-        for s in stmts:
-            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.ClassDef)):
-                continue
-            if isinstance(s, (ast.With, ast.AsyncWith)):
-                self._scan(s.body, held or self._holds_lock(s, locks),
-                           locks, safe, findings)
-                continue
-            if not held:
-                self._scan_stmt_mutations(s, locks, safe, findings)
-            for attr in ("body", "orelse", "finalbody"):
-                sub = getattr(s, attr, None)
-                if isinstance(sub, list) and sub and \
-                        isinstance(sub[0], ast.stmt):
-                    self._scan(sub, held, locks, safe, findings)
-            for h in getattr(s, "handlers", []):
-                self._scan(h.body, held, locks, safe, findings)
-
-    def _scan_stmt_mutations(self, s, locks, safe, findings) -> None:
-        if isinstance(s, ast.Assign):
-            for t in s.targets:
-                self._check_target(t, safe, findings)
-        elif isinstance(s, ast.AugAssign):
-            t = s.target
-            attr = _self_attr(t) or \
-                (_self_attr(t.value) if isinstance(t, ast.Subscript) else "")
-            if attr and attr not in safe:
-                self._flag(findings, s,
-                           f"read-modify-write of shared `self.{attr}` "
-                           "without the lock")
-        elif isinstance(s, ast.Delete):
-            for t in s.targets:
-                if isinstance(t, ast.Subscript):
-                    attr = _self_attr(t.value)
-                    if attr and attr not in safe:
-                        self._flag(findings, s,
-                                   f"del on shared container `self.{attr}` "
-                                   "without the lock")
-        # mutating method calls anywhere in the statement's expressions
-        for node in ast.walk(s):
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in _MUTATORS:
-                attr = _self_attr(node.func.value)
-                if attr and attr not in safe and attr not in locks:
-                    self._flag(findings, node,
-                               f"`.{node.func.attr}()` mutates shared "
-                               f"`self.{attr}` without the lock")
-
-    def _check_target(self, t, safe, findings) -> None:
-        if isinstance(t, ast.Subscript):
-            attr = _self_attr(t.value)
-            if attr and attr not in safe:
-                self._flag(findings, t,
-                           f"item write to shared container `self.{attr}` "
-                           "without the lock")
-        elif isinstance(t, (ast.Tuple, ast.List)):
-            for elt in t.elts:
-                self._check_target(elt, safe, findings)
+        return iter(())

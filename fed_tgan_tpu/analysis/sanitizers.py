@@ -71,7 +71,7 @@ class CompileCounter(logging.Handler):
         if m:
             # logging.Handler.handle() already serialises emit() calls
             # under the handler's own lock
-            self.events.append(m.group(1))  # jaxlint: disable=J05
+            self.events.append(m.group(1))  # jaxlint: disable=L01
             _emit_event("compile", program=m.group(1))
             # live-compile feed for the process-wide cost ledger: the
             # AOT pass records analysis figures, this records the fact

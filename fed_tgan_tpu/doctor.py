@@ -190,7 +190,95 @@ def check_static_analysis() -> bool:
     return _line(True, "static-analysis",
                  f"jaxlint clean: {len(findings)} finding(s) all baselined"
                  f" ({len(stale)} stale baseline entr"
-                 f"{'y' if len(stale) == 1 else 'ies'}, rules J01-J06)")
+                 f"{'y' if len(stale) == 1 else 'ies'}, "
+                 "rules J01-J06 + L01-L04)")
+
+
+def check_locklint(timeout: int = 300) -> bool:
+    """Both prongs of the concurrency subsystem, end to end.
+
+    Static: a subprocess runs the interprocedural lockset rules
+    L01-L04 over the package and must report zero non-baseline
+    findings.  Dynamic: a 2-tenant in-process fleet takes a burst of
+    concurrent requests with the lockwatch sanitizer armed in record
+    mode, and the lock-order graph it builds must close no cycle (and
+    no thread may re-enter a non-reentrant lock)."""
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "fed_tgan_tpu.analysis",
+             "--rules", "L01,L02,L03,L04"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "locklint", f"analyzer timed out ({timeout}s)")
+    if proc.returncode != 0:
+        tail = (proc.stdout or proc.stderr or "").strip().splitlines()
+        return _line(False, "locklint",
+                     f"static findings: {' | '.join(tail[:2])} -- run "
+                     "python -m fed_tgan_tpu.analysis --rules L01-L04")
+
+    tmp = tempfile.mkdtemp(prefix="fed_tgan_doctor_locklint_")
+    svc = None
+    try:
+        from fed_tgan_tpu.analysis import lockwatch
+        from fed_tgan_tpu.serve.demo import build_demo_artifact
+        from fed_tgan_tpu.serve.fleet import (
+            FleetRegistry,
+            FleetService,
+            ProgramCache,
+        )
+
+        with lockwatch.watch(on_deadlock="record"):
+            fleet = FleetRegistry(program_cache=ProgramCache(max_entries=8),
+                                  log=lambda *a: None)
+            for name in ("alpha", "beta"):
+                root = os.path.join(tmp, name)
+                build_demo_artifact(root, rows=200, epochs=1)
+                fleet.load(name, root)
+            svc = FleetService(fleet, port=0, reload_interval_s=0,
+                               log=lambda *a: None).start()
+
+            def burst(tenant):
+                url = f"{svc.url}/t/{tenant}/sample?rows=10&seed=1"
+                for _ in range(3):
+                    with urllib.request.urlopen(url, timeout=120) as r:
+                        r.read()
+
+            threads = [threading.Thread(target=burst, args=(t,))
+                       for t in ("alpha", "beta") for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            svc.shutdown(drain=True)
+            svc = None
+            bad = (lockwatch.reports("cycle")
+                   + lockwatch.reports("reentry"))
+            summary = lockwatch.summary()
+        if bad:
+            return _line(False, "locklint",
+                         f"{len(bad)} runtime report(s): {bad[0].detail}")
+        acq = sum(s["acquisitions"] for s in summary.values())
+        return _line(True, "locklint",
+                     "L01-L04 clean repo-wide; lockwatch-armed 2-tenant "
+                     f"burst: {len(summary)} lock(s) watched, {acq} "
+                     "acquisition(s), no order cycles, no re-entry")
+    except Exception as exc:
+        return _line(False, "locklint", f"{exc!r}")
+    finally:
+        if svc is not None:
+            try:
+                svc.shutdown(drain=False)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def check_program_contracts(timeout: int = 300) -> bool:
@@ -1465,6 +1553,7 @@ def main(argv=None) -> int:
         check_robust_aggregation(),
         check_compile_cache(),
         check_static_analysis(),
+        check_locklint(),
         check_program_contracts(),
         check_precision(),
         check_scan_rounds(),

@@ -1287,6 +1287,11 @@ def fleet_main(argv=None) -> int:
                     help="runtime sanitizers: transfer guards on the lane "
                          "dispatch + a one-compile-per-program budget over "
                          "the shared LRU (exit 4 on violation)")
+    ap.add_argument("--lockwatch", action="store_true",
+                    help="deadlock sanitizer: watch every lock the fleet "
+                         "allocates, build the runtime lock-order graph, "
+                         "and print hold/contention stats at drain (exit "
+                         "4 if an order cycle or re-entry was recorded)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -1304,6 +1309,13 @@ def fleet_main(argv=None) -> int:
         from fed_tgan_tpu.analysis.sanitizers import enable_sanitizers
 
         enable_sanitizers()
+    if args.lockwatch:
+        # installed before the registry/service are built so every lock
+        # they allocate is watched
+        from fed_tgan_tpu.analysis import lockwatch
+
+        lockwatch.clear()
+        lockwatch.install(on_deadlock="record")
     log = (lambda *a, **k: None) if args.quiet else print
     fleet = FleetRegistry(
         program_cache=ProgramCache(max_entries=args.cache_entries,
@@ -1352,6 +1364,20 @@ def fleet_main(argv=None) -> int:
         problems = sanitizers.check_fleet_budget(fleet.cache)
         for problem in problems:
             print(f"SANITIZE: {problem}")
+        if problems:
+            return 4
+    if args.lockwatch:
+        from fed_tgan_tpu.analysis import lockwatch
+
+        lockwatch.uninstall()
+        for lname, st in sorted(lockwatch.summary().items()):
+            print(f"lockwatch: {lname}: {st['acquisitions']} acq "
+                  f"({st['contentions']} contended), hold p99 "
+                  f"{st['hold_p99_ms']:.3f} ms")
+        problems = (lockwatch.reports("cycle")
+                    + lockwatch.reports("reentry"))
+        for rep in problems:
+            print(f"LOCKWATCH: {rep.detail}")
         if problems:
             return 4
     return 0

@@ -1,0 +1,44 @@
+"""Regression fixture: the PR 9 fleet shed deadlock, distilled.
+
+``FleetService.submit`` held ``_adm_lock`` while calling ``_shed``,
+which re-acquires ``_adm_lock`` -- a single-thread self-deadlock that
+only an e2e test caught at the time.  This module reconstructs that
+exact admission-path shape so both prongs of locklint pin it forever:
+
+* **statically** -- L02 must flag the re-acquire in ``_shed``
+  (``tests/test_locklint.py::test_fleet_shed_deadlock_static``);
+* **dynamically** -- with lockwatch armed, ``submit`` over capacity
+  must raise ``DeadlockError`` instead of hanging
+  (``test_fleet_shed_deadlock_dynamic`` instantiates this class under
+  ``lockwatch.watch()`` so ``_adm_lock`` is a watched lock).
+
+Do NOT call ``submit`` past capacity without lockwatch installed: it
+really deadlocks -- that is the point.
+"""
+import threading
+
+
+class MiniFleetService:
+    """Distilled FleetService admission path as shipped in PR 9."""
+
+    def __init__(self, max_inflight=2):
+        self._adm_lock = threading.Lock()
+        self._inflight = {}
+        self._shed_acc = {}
+        self.max_inflight = max_inflight
+
+    def submit(self, req_id):
+        with self._adm_lock:
+            if len(self._inflight) >= self.max_inflight:
+                self._shed(req_id)  # deadlock: _shed re-acquires
+                return False
+            self._inflight[req_id] = True
+        return True
+
+    def _shed(self, req_id):
+        with self._adm_lock:  # EXPECT: L02
+            self._shed_acc[req_id] = self._shed_acc.get(req_id, 0) + 1
+
+    def finish(self, req_id):
+        with self._adm_lock:
+            self._inflight.pop(req_id, None)

@@ -10,8 +10,8 @@ class Stats:
         self.cache = {}
 
     def hit(self, key, value):
-        self.requests += 1  # EXPECT: J05
-        self.cache[key] = value  # EXPECT: J05
+        self.requests += 1  # EXPECT: L01
+        self.cache[key] = value  # EXPECT: L01
 
     def read(self, key):
         with self._lock:
@@ -23,4 +23,4 @@ class NoLockQueue:
         self.items = []
 
     def put(self, item):
-        self.items.append(item)  # EXPECT: J05
+        self.items.append(item)  # EXPECT: L01

@@ -1,0 +1,26 @@
+"""L01 bad twin: shared fields touched without the lock that guards
+them elsewhere (plus the J05-classic never-guarded mutation)."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self.hits = 0
+
+    def put(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+
+    def evict(self, key):
+        del self._entries[key]  # EXPECT: L01
+
+    def bump(self):
+        self.hits += 1  # EXPECT: L01
+
+    def snapshot(self):
+        out = {}
+        for k, v in self._entries.items():  # EXPECT: L01
+            out[k] = v
+        return out

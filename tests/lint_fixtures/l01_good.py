@@ -1,0 +1,40 @@
+"""L01 good twin: guarded mutations, GIL-atomic reads, the
+immutable-swap publish pattern, and a private helper that inherits the
+caller's lockset through the call graph (the shape the lexical J05
+could not prove safe)."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._snapshot = {}
+        self.hits = 0
+
+    def put(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+
+    def evict(self, key):
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+
+    def get(self, key):
+        return self._entries.get(key)  # single dict op: atomic, clean
+
+    def publish(self):
+        with self._lock:
+            fresh = dict(self._entries)
+        self._snapshot = fresh  # plain rebind: immutable-swap, clean
+
+    def clear_all(self):
+        with self._lock:
+            self._clear_locked()
+
+    def _clear_locked(self):
+        self._entries.clear()  # clean: entry must-lockset carries _lock

@@ -1,0 +1,37 @@
+"""L02 bad twin: the PR 9 re-acquire shape and an ABBA order cycle."""
+import threading
+
+
+class Shedder:
+    """submit holds the admission lock and calls a helper that
+    re-acquires it -- the deadlock PR 9 shipped."""
+
+    def __init__(self):
+        self._adm = threading.Lock()
+        self._dropped = 0
+
+    def submit(self, n):
+        with self._adm:
+            if n > 8:
+                self._shed(n)
+
+    def _shed(self, n):
+        with self._adm:  # EXPECT: L02
+            self._dropped += 1
+
+
+class ABBA:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def fwd(self):
+        with self._a:
+            with self._b:  # EXPECT: L02
+                self.n += 1
+
+    def rev(self):
+        with self._b:
+            with self._a:  # EXPECT: L02
+                self.n += 1

@@ -1,0 +1,53 @@
+"""L02 good twin: one global acquisition order, shed-outside-the-lock
+(the PR 9 fix), and RLock re-entry as designed behaviour."""
+import threading
+
+
+class Shedder:
+    def __init__(self):
+        self._adm = threading.Lock()
+        self._dropped = 0
+
+    def submit(self, n):
+        shed = False
+        with self._adm:
+            if n > 8:
+                shed = True
+        if shed:
+            self._shed(n)  # shed OUTSIDE the lock: clean
+
+    def _shed(self, n):
+        with self._adm:
+            self._dropped += 1
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def one(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def two(self):
+        with self._a:
+            with self._b:
+                self.n -= 1
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._tenants = {}
+
+    def load(self, key, value):
+        with self._lock:
+            self._tenants[key] = value
+            self._validate(key)
+
+    def _validate(self, key):
+        with self._lock:  # RLock: designed re-entry, clean
+            return self._tenants.get(key)

@@ -1,0 +1,39 @@
+"""L03 bad twin: blocking calls reached while a lock is held --
+lexically and through the call graph."""
+import queue
+import subprocess
+import threading
+import time
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._ready = threading.Event()
+        self._results = {}
+
+    def drain_bad(self):
+        with self._lock:
+            item = self._q.get()  # EXPECT: L03
+            self._results[item] = True
+        return item
+
+    def wait_bad(self):
+        with self._lock:
+            self._ready.wait()  # EXPECT: L03
+
+    def sleep_bad(self):
+        with self._lock:
+            time.sleep(0.01)  # EXPECT: L03
+
+    def spawn_bad(self):
+        with self._lock:
+            subprocess.run(["true"])  # EXPECT: L03
+
+    def helper_bad(self):
+        with self._lock:
+            self._enqueue()
+
+    def _enqueue(self):
+        self._q.put(object())  # EXPECT: L03

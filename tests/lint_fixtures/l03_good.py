@@ -1,0 +1,29 @@
+"""L03 good twin: snapshot under the lock, block outside it -- and
+``Condition.wait`` on the condition you hold, which releases while
+waiting and is the designed pattern."""
+import queue
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._q = queue.Queue()
+        self._pending = {}
+
+    def drain(self):
+        item = self._q.get()  # blocking outside any lock: clean
+        with self._lock:
+            self._pending[item] = True
+        return item
+
+    def flush(self):
+        with self._lock:
+            todo = list(self._pending)
+        for key in todo:
+            self._q.put(key)  # hoisted out of the lock: clean
+
+    def waiter(self):
+        with self._cv:
+            self._cv.wait(timeout=0.01)  # designed: wait releases _cv

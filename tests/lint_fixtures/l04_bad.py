@@ -1,0 +1,23 @@
+"""L04 bad twin: bare acquires with no with-block / try-finally -- an
+exception between acquire and release leaks the lock."""
+import threading
+
+
+class Leaky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add_bad(self, item):
+        self._lock.acquire()  # EXPECT: L04
+        self._items.append(item)
+        self._lock.release()
+
+    def pop_bad(self):
+        self._lock.acquire()  # EXPECT: L04
+        if not self._items:
+            self._lock.release()
+            return None
+        out = self._items.pop()
+        self._lock.release()
+        return out

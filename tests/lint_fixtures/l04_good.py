@@ -1,0 +1,37 @@
+"""L04 good twin: the with-block, the try/finally pair, the
+non-blocking probe, and the timeout acquire released in finally."""
+import threading
+
+
+class Careful:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def add_try(self, item):
+        self._lock.acquire()
+        try:
+            self._items.append(item)
+        finally:
+            self._lock.release()
+
+    def probe(self):
+        if self._lock.acquire(False):  # non-blocking probe: exempt
+            try:
+                return len(self._items)
+            finally:
+                self._lock.release()
+        return -1
+
+    def add_timeout(self, item):
+        got = self._lock.acquire(timeout=1.0)
+        try:
+            if got:
+                self._items.append(item)
+        finally:
+            if got:
+                self._lock.release()

@@ -29,8 +29,7 @@ def _expected(path: Path):
     return out
 
 
-@pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j05",
-                                     "j06"])
+@pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j06"])
 def test_bad_twin_exact_findings(rule_id):
     path = FIXTURES / f"{rule_id}_bad.py"
     expected = _expected(path)
@@ -42,6 +41,9 @@ def test_bad_twin_exact_findings(rule_id):
 @pytest.mark.parametrize("rule_id", ["j01", "j02", "j03", "j04", "j05",
                                      "j06"])
 def test_good_twin_zero_findings(rule_id):
+    # j05 stays in the list: its good twin must stay clean under the
+    # L01 successor rule too (the J05 bad twin moved to test_locklint's
+    # migration test)
     path = FIXTURES / f"{rule_id}_good.py"
     findings = run_lint(paths=[path])
     assert findings == [], [f.render() for f in findings]
@@ -71,7 +73,7 @@ def test_inline_suppression(tmp_path):
 
 def test_bare_disable_silences_all(tmp_path):
     text = (FIXTURES / "j05_bad.py").read_text().replace(
-        "# EXPECT: J05", "# jaxlint: disable")
+        "# EXPECT: L01", "# jaxlint: disable")
     p = tmp_path / "bare.py"
     p.write_text(text)
     assert run_lint(paths=[p]) == []
@@ -125,7 +127,8 @@ def test_cli_rule_filter():
 
 def test_rule_registry_complete():
     assert {r.rule_id for r in ALL_RULES} == {
-        "J01", "J02", "J03", "J04", "J05", "J06"}
+        "J01", "J02", "J03", "J04", "J05", "J06",
+        "L01", "L02", "L03", "L04"}
     for rid, rule in RULES_BY_ID.items():
         assert rule.rule_id == rid and rule.hint and rule.title
 

@@ -37,6 +37,19 @@ pytestmark = pytest.mark.fleet
 _silent = lambda *a, **k: None  # noqa: E731
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lockwatch_armed():
+    """Arm the runtime deadlock sanitizer for the whole module: every
+    lock the front door allocates is watched, and any lock-order cycle
+    the tests drive fails the module at teardown."""
+    from fed_tgan_tpu.analysis import lockwatch
+
+    with lockwatch.watch(on_deadlock="record"):
+        yield
+        bad = lockwatch.reports("cycle") + lockwatch.reports("reentry")
+        assert bad == [], [r.detail for r in bad]
+
+
 @pytest.fixture(scope="module")
 def tenant_roots(tmp_path_factory):
     from fed_tgan_tpu.serve.demo import build_demo_artifact
