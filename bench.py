@@ -453,8 +453,11 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
     journal = tracer = None
     if obs_dir:
         os.makedirs(obs_dir, exist_ok=True)
+        # validate=True: the round workload doubles as the telemetry
+        # contract soak -- any emit drifting from obs/schema.json lands
+        # in the record's schema_violations figure (budgeted to 0)
         journal = RunJournal(os.path.join(obs_dir, "journal.jsonl"),
-                             run_id="bench_round")
+                             run_id="bench_round", validate=True)
         set_journal(journal)
         tracer = start_tracing()
     try:
@@ -575,6 +578,7 @@ def bench_round(rounds: int = 8, bgm_backend: str = "sklearn",
             metrics_path = os.path.join(obs_dir, "metrics.prom")
             with open(metrics_path, "w") as f:
                 f.write(get_registry().render_prometheus())
+            result["schema_violations"] = journal.schema_violations
             result["obs"] = {
                 "journal": journal.path,
                 "trace": trace_path,

@@ -1,12 +1,19 @@
-"""``python -m fed_tgan_tpu.analysis`` -- the jaxlint + hlolint CLI.
+"""``python -m fed_tgan_tpu.analysis`` -- the analysis-family CLI.
 
 Default mode is the static lint (rules J01-J06 + the locklint
 concurrency rules L01-L04, no JAX import).
+``--telemetry`` switches to obslint (telemetry contracts O01-O05): the
+pure-AST extraction of every journal emit site, metric get-or-create
+site, obs consumer read, budget selector, and fault-spec reference is
+cross-checked against the registry ``fed_tgan_tpu/obs/schema.json``
+(``--schema-update`` regenerates/merges the registry from the tree).
 ``--contracts`` switches to the IR program contracts: every jitted
 entrypoint is AOT-lowered on a simulated 8-device CPU mesh and its
 fingerprint diffed against the checked-in ``analysis/contracts/*.json``
 (``--contracts-update`` re-records them; ``--explain`` names the op
 delta and candidate source sites).
+``--all`` runs every prong (jaxlint+locklint, obslint, hlolint
+contracts) and prints one summary table with an aggregated exit code.
 
 Exit codes: 0 clean (or all findings baselined / contracts honored),
 1 new findings / contract regression, 2 usage, parse, or lowering error.
@@ -93,34 +100,163 @@ def build_parser() -> argparse.ArgumentParser:
                          "checked-in analysis/contracts/, others get a "
                          "sibling subdirectory, e.g. analysis/contracts/"
                          "tpu/); see runtime/backend.py")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="check the telemetry contracts (obslint "
+                         "O01-O05) against obs/schema.json instead of "
+                         "linting")
+    ap.add_argument("--schema-update", action="store_true",
+                    help="with --telemetry: regenerate/merge the schema "
+                         "registry from the current tree (additive; "
+                         "curated entries are never deleted)")
+    ap.add_argument("--schema", type=Path, default=None,
+                    help="with --telemetry: schema registry path "
+                         "(default: the checked-in obs/schema.json)")
+    ap.add_argument("--budgets", type=Path, default=None,
+                    help="with --telemetry: budgets JSON for the O04 "
+                         "selector check (default: obs/budgets.json on "
+                         "a repo-wide run)")
+    ap.add_argument("--all", action="store_true", dest="all_prongs",
+                    help="run every analysis prong (jaxlint+locklint, "
+                         "obslint, hlolint contracts) with one summary "
+                         "table and an aggregated exit code")
     return ap
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+def _contracts_mode(args) -> int:
+    # imported lazily: the contracts prong needs JAX, the lint prong
+    # must keep its millisecond no-JAX startup
+    from fed_tgan_tpu.analysis.contracts.check import run_contracts
 
-    if args.contracts or args.contracts_update:
-        # imported lazily: the contracts prong needs JAX, the lint prong
-        # must keep its millisecond no-JAX startup
-        from fed_tgan_tpu.analysis.contracts.check import run_contracts
+    contracts_dir = args.contracts_dir
+    if contracts_dir is None and args.backend is not None:
+        from fed_tgan_tpu.runtime.backend import contracts_dir_for
 
-        contracts_dir = args.contracts_dir
-        if contracts_dir is None and args.backend is not None:
-            from fed_tgan_tpu.runtime.backend import contracts_dir_for
+        try:
+            contracts_dir = contracts_dir_for(args.backend)
+        except ValueError as exc:
+            print(f"contracts: {exc}", file=sys.stderr)
+            return 2
 
-            try:
-                contracts_dir = contracts_dir_for(args.backend)
-            except ValueError as exc:
-                print(f"contracts: {exc}", file=sys.stderr)
-                return 2
+    return run_contracts(
+        update=args.contracts_update,
+        explain=args.explain,
+        fmt=args.format,
+        contracts_dir=contracts_dir,
+    )
 
-        return run_contracts(
-            update=args.contracts_update,
-            explain=args.explain,
-            fmt=args.format,
-            contracts_dir=contracts_dir,
-        )
 
+def _telemetry_mode(args) -> int:
+    from fed_tgan_tpu.analysis.telemetry import (
+        RULE_IDS,
+        extract_repo,
+        generate_schema,
+        load_schema,
+        run_telemetry,
+        save_schema,
+    )
+    from fed_tgan_tpu.analysis.telemetry.schema import DEFAULT_SCHEMA_PATH
+
+    if args.schema_update:
+        try:
+            ex = extract_repo(args.paths or None)
+            path = args.schema or DEFAULT_SCHEMA_PATH
+            existing = load_schema(path) if path.exists() else None
+            schema, added = generate_schema(ex, existing=existing)
+            save_schema(schema, path)
+        except LintError as exc:
+            print(f"obslint: {exc}", file=sys.stderr)
+            return 2
+        print(f"obslint: schema updated: {len(added)} addition(s) "
+              f"-> {path}")
+        for entry in added:
+            print(f"  + {entry}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = expand_rule_ids(args.rules)
+        unknown = sorted(set(rules) - set(RULE_IDS))
+        if unknown:
+            print(f"obslint: unknown rule(s) {', '.join(unknown)} "
+                  f"(have {', '.join(RULE_IDS)})", file=sys.stderr)
+            return 2
+
+    try:
+        findings, coverage = run_telemetry(
+            args.paths or None, schema_path=args.schema,
+            budgets_path=args.budgets, rules=rules)
+    except LintError as exc:
+        print(f"obslint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.baseline_update:
+        path = save_baseline(findings, args.baseline)
+        print(f"obslint: baseline updated: {len(findings)} finding(s) "
+              f"-> {path}")
+        return 0
+
+    try:
+        baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    except LintError as exc:
+        print(f"obslint: {exc}", file=sys.stderr)
+        return 2
+    new, old, stale = apply_baseline(findings, baseline)
+    stale = {k for k in stale
+             if k.split(":")[1].startswith("O")}  # jaxlint keys aren't ours
+
+    cov = (f"schema covers {coverage['emit_sites_covered']}/"
+           f"{coverage['emit_sites']} emit site(s), "
+           f"{coverage['metric_sites_covered']}/"
+           f"{coverage['metric_sites']} metric site(s)")
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "new": [f.key for f in new],
+            "baselined": [f.key for f in old],
+            "stale_baseline": sorted(stale),
+            "coverage": coverage,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for key in sorted(stale):
+            print(f"obslint: stale baseline entry (fixed? run "
+                  f"--baseline-update to drop): {key}")
+        print(f"obslint: {len(findings)} finding(s): {len(new)} new, "
+              f"{len(old)} baselined, {len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'}; {cov}")
+    return 1 if new else 0
+
+
+def _all_mode(args) -> int:
+    """Every prong, one summary table, aggregated exit code."""
+    import argparse as _argparse
+
+    rows = []
+    lint_args = _argparse.Namespace(**vars(args))
+    lint_args.rules = ""
+    rc = _lint_mode(lint_args)
+    rows.append(("jaxlint+locklint", rc))
+    tel_args = _argparse.Namespace(**vars(args))
+    tel_args.rules = ""
+    tel_args.schema_update = False
+    rc = _telemetry_mode(tel_args)
+    rows.append(("obslint", rc))
+    con_args = _argparse.Namespace(**vars(args))
+    con_args.contracts_update = False
+    rc = _contracts_mode(con_args)
+    rows.append(("hlolint contracts", rc))
+
+    width = max(len(name) for name, _ in rows)
+    print("\nanalysis --all summary:")
+    for name, rc in rows:
+        status = {0: "ok", 1: "FINDINGS", 2: "ERROR"}.get(rc, f"exit {rc}")
+        print(f"  {name:<{width}}  {status}")
+    codes = [rc for _, rc in rows]
+    return 2 if 2 in codes else (1 if 1 in codes else 0)
+
+
+def _lint_mode(args) -> int:
     rules = None
     if args.rules:
         try:
@@ -148,6 +284,8 @@ def main(argv=None) -> int:
         print(f"jaxlint: {exc}", file=sys.stderr)
         return 2
     new, old, stale = apply_baseline(findings, baseline)
+    stale = {k for k in stale
+             if not k.split(":")[1].startswith("O")}  # obslint keys
 
     if args.format == "json":
         print(json.dumps({
@@ -167,6 +305,17 @@ def main(argv=None) -> int:
               f"entr{'y' if len(stale) == 1 else 'ies'} "
               f"[rules: {', '.join(r.rule_id for r in (rules or ALL_RULES))}]")
     return 1 if new else 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.all_prongs:
+        return _all_mode(args)
+    if args.contracts or args.contracts_update:
+        return _contracts_mode(args)
+    if args.telemetry or args.schema_update:
+        return _telemetry_mode(args)
+    return _lint_mode(args)
 
 
 if __name__ == "__main__":

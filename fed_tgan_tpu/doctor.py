@@ -194,6 +194,37 @@ def check_static_analysis() -> bool:
                  "rules J01-J06 + L01-L04)")
 
 
+def check_analysis_all(timeout: int = 600) -> bool:
+    """The unified analysis gate: shells ``python -m
+    fed_tgan_tpu.analysis --all`` (jaxlint+locklint, obslint telemetry
+    contracts, hlolint program contracts) and requires the aggregated
+    exit code to be 0."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "fed_tgan_tpu.analysis", "--all"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return _line(False, "analysis-all", f"timed out ({timeout}s)")
+    summary = [ln.strip() for ln in proc.stdout.splitlines()
+               if ln.strip() and not ln.startswith("analysis --all")]
+    if proc.returncode != 0:
+        bad = [ln for ln in summary if "ok" not in ln.split()[-1:]]
+        return _line(False, "analysis-all",
+                     f"exit {proc.returncode}: "
+                     + ("; ".join(bad[:3]) or "see python -m "
+                        "fed_tgan_tpu.analysis --all"))
+    prongs = [ln for ln in summary
+              if ln.endswith("ok") and not ln.startswith(("jaxlint:",
+                                                          "obslint:"))]
+    return _line(True, "analysis-all",
+                 f"{len(prongs)} prong(s) clean: "
+                 + ", ".join(p.split()[0] for p in prongs))
+
+
 def check_locklint(timeout: int = 300) -> bool:
     """Both prongs of the concurrency subsystem, end to end.
 
@@ -1092,8 +1123,13 @@ def check_observability() -> bool:
         from fed_tgan_tpu.obs.journal import RunJournal, read_journal
 
         jpath = os.path.join(tmp, "journal.jsonl")
-        with RunJournal(jpath, run_id="doctor") as j:
+        with RunJournal(jpath, run_id="doctor", validate=True) as j:
             j.emit("round", first=0, last=0, rounds=1, per_round_s=0.01)
+        if j.schema_violations:
+            return _line(False, "observability",
+                         f"{j.schema_violations} journal schema "
+                         "violation(s) -- run python -m "
+                         "fed_tgan_tpu.analysis --telemetry")
         events = list(read_journal(jpath))
         types = [e.get("type") for e in events]
         if types != ["run_start", "round", "run_end"]:
@@ -1555,6 +1591,7 @@ def main(argv=None) -> int:
         check_static_analysis(),
         check_locklint(),
         check_program_contracts(),
+        check_analysis_all(),
         check_precision(),
         check_scan_rounds(),
         check_cohort_scale(),

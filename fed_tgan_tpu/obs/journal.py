@@ -10,6 +10,12 @@ The first line of a journal is always a ``run_start`` event carrying
 key off ``type``; unknown types must be ignored, unknown fields
 preserved (the schema is append-only: fields are added, never renamed).
 
+``EVENT_TYPES`` is derived from the checked-in telemetry contract
+registry ``fed_tgan_tpu/obs/schema.json`` (the obslint source of
+truth: per-event required/optional/external fields and producers).
+The catalogue below is the prose mirror of that registry;
+``tests/test_obslint.py`` holds the two in sync.
+
 Event catalogue (``EVENT_TYPES``):
 
 ========================  ====================================================
@@ -46,6 +52,8 @@ transport_drop            server marked a peer dead
 heartbeat_lapse           liveness deadline exceeded for a peer
 compile                   XLA compile event (from the sanitizer counter)
 backend_probe             subprocess backend-responsiveness probe outcome
+backend_plugin_registered PJRT plugin backend registered with the runtime
+                          (plugin name, shared-library path)
 device_trace              runtime/profiling device trace start/stop/failure
 serve_reload              serving hot-reloaded a model artifact
 serve_reload_failed       a new checkpoint generation failed to load (torn
@@ -76,6 +84,10 @@ similarity                monitor probe sample (epoch, avg_jsd, avg_wd and,
                           when available, per-column values)
 slo_breach                live SLO re-evaluation flagged a budget regression
                           (rule name, figure, bound) -- emitted by obs watch
+schema_violation          the runtime schema sanitizer (``validate=True``)
+                          saw an emit that breaks the registry contract
+                          (offending event type, problem, field); emitted
+                          once per distinct violation, never raised
 ========================  ====================================================
 
 Writers go through a process-wide current journal: ``set_journal``
@@ -103,24 +115,44 @@ __all__ = [
     "get_journal",
     "read_journal",
     "set_journal",
+    "validation_violations",
 ]
 
 SCHEMA_VERSION = 1
 
-EVENT_TYPES = frozenset({
-    "run_start", "run_end",
-    "round", "aggregate", "cohort",
-    "quarantine", "client_dropped",
-    "client_joined", "client_left", "drift_alarm", "drift_window",
-    "watchdog_alarm", "watchdog_rollback",
-    "checkpoint", "checkpoint_restore",
-    "transport_reconnect", "transport_drop", "heartbeat_lapse",
-    "compile", "backend_probe", "device_trace", "serve_reload",
-    "serve_reload_failed", "promotion_promoted", "promotion_rejected",
-    "fleet_load", "fleet_evict", "tenant_shed",
-    "program_cost", "init_phase", "serve_stages", "init_cache",
-    "client_contribution", "similarity", "slo_breach",
-})
+SCHEMA_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "schema.json")
+
+# journals must keep working from a tree without the registry (sdist
+# subsets, very old checkouts): a missing/corrupt schema.json leaves
+# EVENT_TYPES empty and the runtime sanitizer disarmed.
+def _load_event_schemas() -> Dict[str, dict]:
+    try:
+        with open(SCHEMA_PATH) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    events = doc.get("events") if isinstance(doc, dict) else None
+    if not isinstance(events, dict):
+        return {}
+    return {name: spec for name, spec in events.items()
+            if isinstance(spec, dict)}
+
+
+_EVENT_SCHEMAS = _load_event_schemas()
+EVENT_TYPES = frozenset(_EVENT_SCHEMAS)
+
+_VALIDATE_ENV = "FED_TGAN_TPU_VALIDATE_JOURNAL"
+_BASE_FIELDS = frozenset({"ts", "type"})
+
+# violations seen by env-armed journals (the tier-1 arming path);
+# the test session gate asserts this stays empty across the suite
+_VALIDATION_VIOLATIONS: List[dict] = []
+
+
+def validation_violations() -> List[dict]:
+    """Schema violations recorded by env-armed journals this process."""
+    return list(_VALIDATION_VIOLATIONS)
 
 
 class RunJournal:
@@ -128,13 +160,35 @@ class RunJournal:
 
     ``emit()`` never raises into the instrumented caller: a journal
     that loses its disk must not take the training run down with it.
+
+    ``validate`` arms the runtime schema sanitizer: every emit is
+    checked against the ``obs/schema.json`` contract (unknown type,
+    missing required field, unlisted field on a closed event) and each
+    distinct violation journals one ``schema_violation`` event, bumps
+    ``self.schema_violations`` and the
+    ``fed_tgan_journal_schema_violations_total`` counter -- it never
+    raises.  ``validate=None`` (the default) arms from the
+    ``FED_TGAN_TPU_VALIDATE_JOURNAL`` env var (how tier-1 tests, soak,
+    and doctor run) and additionally tallies into the process-wide
+    :func:`validation_violations` list the test session gate asserts
+    empty.
     """
 
-    def __init__(self, path: str, run_id: Optional[str] = None) -> None:
+    def __init__(self, path: str, run_id: Optional[str] = None,
+                 validate: Optional[bool] = None) -> None:
         self.path = str(path)
         self.run_id = run_id or uuid.uuid4().hex[:12]
         directory = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(directory, exist_ok=True)
+        if validate is None:
+            env = os.environ.get(_VALIDATE_ENV, "")
+            validate = env.lower() not in ("", "0", "false", "no")
+            self._tally_global = validate
+        else:
+            self._tally_global = False
+        self.validate = bool(validate) and bool(_EVENT_SCHEMAS)
+        self.schema_violations = 0
+        self._violation_keys: set = set()
         self._lock = threading.Lock()
         self._fh = open(self.path, "a", buffering=1)
         self._t0 = time.time()
@@ -142,10 +196,54 @@ class RunJournal:
         self.emit("run_start", schema=SCHEMA_VERSION, run_id=self.run_id,
                   pid=os.getpid())
 
+    def _check_schema(self, type: str, fields: dict) -> List[tuple]:
+        """``(problem, field)`` pairs for one emit; [] when clean."""
+        spec = _EVENT_SCHEMAS.get(type)
+        if spec is None:
+            return [("unknown_type", None)]
+        problems = []
+        for req in spec.get("required", ()):
+            if req not in fields:
+                problems.append(("missing_field", req))
+        if not spec.get("open", False):
+            known = (set(spec.get("required", ()))
+                     | set(spec.get("optional", ()))
+                     | set(spec.get("external", ())) | _BASE_FIELDS)
+            problems.extend(("unknown_field", f)
+                            for f in sorted(fields) if f not in known)
+        return problems
+
+    def _record_violation(self, type: str, problem: str,
+                          field: Optional[str]) -> None:
+        key = (type, problem, field)
+        with self._lock:
+            if key in self._violation_keys:
+                return
+            self._violation_keys.add(key)
+            self.schema_violations += 1
+        if self._tally_global:
+            _VALIDATION_VIOLATIONS.append(
+                {"event": type, "problem": problem, "field": field,
+                 "path": self.path})
+        try:
+            # lazy: the registry must not be an import-time dependency
+            from fed_tgan_tpu.obs.registry import counter as _schema_counter
+
+            _schema_counter(
+                "fed_tgan_journal_schema_violations_total").inc()
+        except Exception:  # noqa: BLE001 -- sanitizer never raises
+            pass
+        extra = {"field": field} if field is not None else {}
+        self.emit("schema_violation", event=type, problem=problem, **extra)
+
     def emit(self, type: str, **fields) -> Optional[dict]:
         """Append one event; returns the event dict (None if closed)."""
+        type = str(type)
+        if self.validate and type != "schema_violation":
+            for problem, field in self._check_schema(type, fields):
+                self._record_violation(type, problem, field)
         event: Dict[str, object] = {"ts": round(time.time(), 6),
-                                    "type": str(type)}
+                                    "type": type}
         event.update(fields)
         try:
             line = json.dumps(event, default=str)

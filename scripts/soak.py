@@ -214,7 +214,7 @@ def run_churn_soak(seed: int = 0, epochs: int = 50, out_dir: str = None,
                       # own soak (run_soak's random draws)
                       update_gate=False)
     journal = RunJournal(os.path.join(out_dir, "journal.jsonl"),
-                         run_id=f"churn-soak-{seed}")
+                         run_id=f"churn-soak-{seed}", validate=True)
     prev = set_journal(journal)
     install_plan(FaultPlan.parse(plan_spec))
     out = {"seed": seed, "epochs": n_epochs, "out_dir": out_dir,

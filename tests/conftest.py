@@ -10,6 +10,12 @@ import os
 # XLA reads this when the CPU client is created, which is late enough.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
+# Arm the runtime telemetry sanitizer (obslint's runtime prong) for every
+# journal the suite opens: any event shape that drifts from
+# obs/schema.json journals a schema_violation, and the session gate below
+# fails the run.  Before the jax import: subprocess tests inherit it.
+os.environ.setdefault("FED_TGAN_TPU_VALIDATE_JOURNAL", "1")
+
 # This environment pre-imports jax at interpreter startup (a site .pth hook)
 # with JAX_PLATFORMS=axon already set, so the env-var route is too late —
 # override through the config API before any backend initializes.
@@ -53,6 +59,28 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # obslint runtime gate: every env-armed journal the suite opened must
+    # have validated cleanly.  A green suite with schema drift is a lie,
+    # so violations flip the exit status even when every test passed.
+    from fed_tgan_tpu.obs.journal import validation_violations
+
+    violations = validation_violations()
+    if violations and exitstatus == 0:
+        rep = session.config.pluginmanager.get_plugin("terminalreporter")
+        if rep is not None:
+            rep.write_line("")
+            rep.write_line(
+                f"obslint runtime gate: {len(violations)} journal schema "
+                "violation(s) across the suite (see obs/schema.json):",
+                red=True)
+            for v in violations[:20]:
+                rep.write_line(f"  {v['event']}: {v['problem']}"
+                               + (f" ({v['field']})" if v["field"] else "")
+                               + f" [{v['path']}]", red=True)
+        session.exitstatus = 1
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,7 @@
+"""obslint O05 bad twin: fault-spec strings faults.py cannot parse.
+
+Never imported -- parsed by the analyzer only.
+"""
+
+PLAN = "kill_clientt:rank=1,round=2"  # EXPECT: O05
+NOTE = "inject delay_msg:ms=50 then sever_con:rank=1,after=2"  # EXPECT: O05

@@ -45,6 +45,7 @@ def test_fault_plan_parse_grammar():
     # crash_checkpoint defaults to the first save
     assert FaultPlan.parse("crash_checkpoint").crash_save == 1
     with pytest.raises(ValueError, match="unknown fault"):
+        # jaxlint: disable=O05 -- intentionally unparseable kind
         FaultPlan.parse("set_on_fire:rank=1")
 
 

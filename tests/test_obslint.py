@@ -1,0 +1,262 @@
+"""Tests for obslint: telemetry-contract analysis (rules O01-O05), the
+checked-in schema registry, and the runtime journal schema sanitizer.
+
+Fixture twins live in ``tests/lint_fixtures/`` and are checked against a
+dedicated fixture registry (``obslint_schema.json``) so these tests do
+not churn when the live ``fed_tgan_tpu/obs/schema.json`` is curated.
+Bad twins carry ``# EXPECT: OXX`` markers on each line a rule must flag.
+"""
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+import fed_tgan_tpu.obs.journal as journal_mod
+from fed_tgan_tpu.analysis.__main__ import main as lint_main
+from fed_tgan_tpu.analysis.telemetry import (
+    DEFAULT_SCHEMA_PATH,
+    RULE_IDS,
+    RULE_TITLES,
+    load_schema,
+    run_telemetry,
+)
+from fed_tgan_tpu.obs.journal import EVENT_TYPES, RunJournal
+
+pytestmark = pytest.mark.obslint
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+FIXTURE_SCHEMA = FIXTURES / "obslint_schema.json"
+
+_EXPECT_RE = re.compile(r"# EXPECT: (O\d\d)")
+
+
+def _expected(path: Path):
+    out = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        for rule in _EXPECT_RE.findall(line):
+            out.add((rule, lineno))
+    return out
+
+
+def _run(paths, **kw):
+    findings, _cov = run_telemetry(
+        paths=paths, schema_path=FIXTURE_SCHEMA, **kw)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# static prong: fixture twins
+# ---------------------------------------------------------------------------
+
+TWINS = ["o01", "o02", "o03", "o05"]
+
+
+@pytest.mark.parametrize("stem", TWINS)
+def test_bad_twin_exact_findings(stem):
+    bad = FIXTURES / f"{stem}_bad.py"
+    findings = _run([bad])
+    got = {(f.rule, f.line) for f in findings}
+    assert got == _expected(bad)
+    for f in findings:
+        assert f.hint and f.rule in RULE_TITLES
+
+
+@pytest.mark.parametrize("stem", TWINS)
+def test_good_twin_zero_findings(stem):
+    assert _run([FIXTURES / f"{stem}_good.py"]) == []
+
+
+def test_o04_bad_budgets():
+    findings = _run([FIXTURES / "o01_good.py"],
+                    budgets_path=FIXTURES / "o04_bad_budgets.json")
+    assert [f.rule for f in findings] == ["O04"] * 3
+    blob = " ".join(f.message for f in findings)
+    assert "ghost-bench" in blob and "bad-backend" in blob
+    assert "ghost-figure" in blob
+
+
+def test_o04_good_budgets():
+    assert _run([FIXTURES / "o01_good.py"],
+                budgets_path=FIXTURES / "o04_good_budgets.json") == []
+
+
+def test_inline_suppression(tmp_path):
+    src = (FIXTURES / "o03_bad.py").read_text()
+    sup = tmp_path / "suppressed.py"
+    sup.write_text(src.replace("# EXPECT: O03", "# jaxlint: disable=O03"))
+    assert _run([sup]) == []
+
+
+# ---------------------------------------------------------------------------
+# repo-wide gate: the live registry must stay in sync with the tree
+# ---------------------------------------------------------------------------
+
+def test_repo_wide_clean_and_fully_covered():
+    findings, cov = run_telemetry()
+    assert findings == [], [f.key for f in findings]
+    assert cov["emit_sites"] > 0 and cov["metric_sites"] > 0
+    assert cov["emit_sites_covered"] == cov["emit_sites"]
+    assert cov["metric_sites_covered"] == cov["metric_sites"]
+
+
+def test_event_types_derived_from_schema():
+    schema = load_schema(DEFAULT_SCHEMA_PATH)
+    assert EVENT_TYPES == frozenset(schema["events"])
+    assert "schema_violation" in EVENT_TYPES
+    assert "backend_plugin_registered" in EVENT_TYPES
+
+
+def test_docstring_catalogue_in_sync():
+    doc = journal_mod.__doc__
+    for name in load_schema(DEFAULT_SCHEMA_PATH)["events"]:
+        assert name in doc, f"event {name!r} missing from journal docstring"
+
+
+# ---------------------------------------------------------------------------
+# runtime prong: the journal schema sanitizer
+# ---------------------------------------------------------------------------
+
+def _read_events(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def test_every_event_type_round_trips_clean(tmp_path):
+    schema = load_schema(DEFAULT_SCHEMA_PATH)
+    jpath = tmp_path / "all_events.jsonl"
+    j = RunJournal(jpath, run_id="rt", validate=True)
+    for name, spec in sorted(schema["events"].items()):
+        j.emit(name, **{f: 0 for f in spec["required"]})
+    j.close()
+    assert j.schema_violations == 0
+    types = [e["type"] for e in _read_events(jpath) if e["type"] != "run_meta"]
+    assert set(types) == set(schema["events"])
+
+
+def test_validator_flags_unknown_type(tmp_path):
+    j = RunJournal(tmp_path / "j.jsonl", run_id="rt", validate=True)
+    j.emit("totally_unknown_zz", x=1)
+    j.close()
+    assert j.schema_violations == 1
+    viol = [e for e in _read_events(tmp_path / "j.jsonl")
+            if e["type"] == "schema_violation"]
+    assert viol and viol[0]["problem"] == "unknown_type"
+    assert viol[0]["event"] == "totally_unknown_zz"
+
+
+def test_validator_flags_missing_and_unknown_field(tmp_path):
+    j = RunJournal(tmp_path / "j.jsonl", run_id="rt", validate=True)
+    j.emit("round", last=3)            # missing required 'first'
+    j.emit("round", first=1, bogus_zz=2)   # unknown field on closed event
+    j.close()
+    problems = {(e["problem"], e.get("field"))
+                for e in _read_events(tmp_path / "j.jsonl")
+                if e["type"] == "schema_violation"}
+    assert ("missing_field", "first") in problems
+    assert ("unknown_field", "bogus_zz") in problems
+
+
+def test_validator_dedups_repeat_violations(tmp_path):
+    j = RunJournal(tmp_path / "j.jsonl", run_id="rt", validate=True)
+    for _ in range(5):
+        j.emit("totally_unknown_zz", x=1)
+    j.close()
+    assert j.schema_violations == 1
+
+
+def test_open_events_accept_any_shape(tmp_path):
+    j = RunJournal(tmp_path / "j.jsonl", run_id="rt", validate=True)
+    j.emit("program_cost", name="p", anything_goes=1, whatever=2)
+    j.close()
+    assert j.schema_violations == 0
+
+
+def test_validate_false_disarms(tmp_path):
+    j = RunJournal(tmp_path / "j.jsonl", run_id="rt", validate=False)
+    j.emit("totally_unknown_zz", x=1)
+    j.close()
+    assert j.schema_violations == 0
+    assert all(e["type"] != "schema_violation"
+               for e in _read_events(tmp_path / "j.jsonl"))
+
+
+def test_env_arming_and_global_tally(tmp_path, monkeypatch):
+    monkeypatch.setenv("FED_TGAN_TPU_VALIDATE_JOURNAL", "1")
+    n_before = len(journal_mod._VALIDATION_VIOLATIONS)
+    j = RunJournal(tmp_path / "j.jsonl", run_id="rt")  # validate=None -> env
+    try:
+        j.emit("totally_unknown_zz", x=1)
+        j.close()
+        assert j.schema_violations == 1
+        tail = journal_mod._VALIDATION_VIOLATIONS[n_before:]
+        assert any(v["event"] == "totally_unknown_zz" for v in tail)
+    finally:
+        # scrub the deliberate violation so the conftest session gate
+        # (which fails tier-1 on any env-armed violation) stays green
+        del journal_mod._VALIDATION_VIOLATIONS[n_before:]
+
+    monkeypatch.setenv("FED_TGAN_TPU_VALIDATE_JOURNAL", "0")
+    j2 = RunJournal(tmp_path / "j2.jsonl", run_id="rt")
+    j2.emit("totally_unknown_zz", x=1)
+    j2.close()
+    assert j2.schema_violations == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes(capsys):
+    bad = str(FIXTURES / "o01_bad.py")
+    good = str(FIXTURES / "o01_good.py")
+    schema = ["--schema", str(FIXTURE_SCHEMA)]
+    assert lint_main(["--telemetry", good, "--no-baseline"] + schema) == 0
+    assert lint_main(["--telemetry", bad, "--no-baseline"] + schema) == 1
+    out = capsys.readouterr().out
+    assert "O01" in out and "o01_bad.py" in out
+    assert lint_main(["--telemetry", bad, "--no-baseline",
+                      "--rules", "O99"]) == 2
+    assert lint_main(["--telemetry", good, "--no-baseline",
+                      "--schema", str(FIXTURES / "no_such_schema.json")]) == 2
+
+
+def test_cli_json_format(capsys):
+    assert lint_main(["--telemetry", str(FIXTURES / "o03_bad.py"),
+                      "--no-baseline", "--schema", str(FIXTURE_SCHEMA),
+                      "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in payload["findings"]} == {"O03"}
+    assert payload["coverage"]["metric_sites"] > 0
+
+
+def test_cli_baseline_ratchet(tmp_path):
+    bad = str(FIXTURES / "o05_bad.py")
+    bl = tmp_path / "bl.json"
+    schema = ["--schema", str(FIXTURE_SCHEMA)]
+    assert lint_main(["--telemetry", bad, "--baseline", str(bl),
+                      "--baseline-update"] + schema) == 0
+    keys = set(json.loads(bl.read_text())["findings"])
+    assert keys and all(":O05:" in k for k in keys)
+    assert lint_main(["--telemetry", bad, "--baseline", str(bl)]
+                     + schema) == 0  # ratcheted
+
+
+def test_cli_schema_update_roundtrip(tmp_path, capsys):
+    schema_path = tmp_path / "schema.json"
+    paths = [str(FIXTURES / "o01_good.py"), str(FIXTURES / "o03_good.py")]
+    assert lint_main(["--telemetry", "--schema-update",
+                      "--schema", str(schema_path)] + paths) == 0
+    first = capsys.readouterr().out
+    assert "schema updated" in first and schema_path.exists()
+    # idempotent: a second pass discovers nothing new
+    assert lint_main(["--telemetry", "--schema-update",
+                      "--schema", str(schema_path)] + paths) == 0
+    assert "0 addition(s)" in capsys.readouterr().out
+    # and the generated registry is self-consistent for those files
+    assert lint_main(["--telemetry", "--no-baseline",
+                      "--schema", str(schema_path)] + paths) == 0
+
+
+def test_rule_registry_complete():
+    assert RULE_IDS == ("O01", "O02", "O03", "O04", "O05")
+    assert set(RULE_TITLES) == set(RULE_IDS)

@@ -83,7 +83,8 @@ def test_parse_update_faults():
 
 def test_parse_unknown_kind_fails_fast():
     with pytest.raises(ValueError) as e:
-        FaultPlan.parse("nan_updat:rank=1")  # typo must not silently no-op
+        # typo must not silently no-op  # jaxlint: disable=O05
+        FaultPlan.parse("nan_updat:rank=1")
     msg = str(e.value)
     assert "nan_updat" in msg
     for kind in FaultPlan.VALID_KINDS:
